@@ -1,0 +1,90 @@
+"""Shared vocabulary between the scheduler and the engine tier.
+
+Equivalent of the reference's src/ipc.rs: a ``Position`` is one search
+job (a slice of a batch), a ``PositionResponse`` its result, and
+``PositionFailed`` poisons the whole batch (the scheduler abandons it and
+lets the server reassign by timeout, src/queue.rs:207-214).
+
+In the reference these types cross a process boundary to a Stockfish
+subprocess; here they cross into the batched TPU engine service — the
+exact seam identified in SURVEY.md §3.3.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from fishnet_tpu.protocol.types import (
+    AnalysisPart,
+    AnalysisPartJson,
+    EngineFlavor,
+    Matrix,
+    Score,
+    Variant,
+    Work,
+)
+
+
+@dataclass(frozen=True)
+class Position:
+    """One position to search: root FEN plus the UCI moves leading to it
+    (ipc.rs:16-26). ``position_id`` is the ply index within the batch."""
+
+    work: Work
+    position_id: int
+    flavor: EngineFlavor
+    variant: Variant
+    root_fen: str
+    moves: List[str] = field(default_factory=list)
+    url: Optional[str] = None
+
+
+@dataclass
+class PositionResponse:
+    """Search result for one position (ipc.rs:28-65). ``scores`` and
+    ``pvs`` are multipv x depth matrices; ``best`` picks the deepest
+    first-PV entry."""
+
+    work: Work
+    position_id: int
+    scores: Matrix
+    pvs: Matrix
+    best_move: Optional[str]
+    depth: int
+    nodes: int
+    time_seconds: float
+    nps: Optional[int] = None
+    url: Optional[str] = None
+
+    def to_best(self) -> AnalysisPartJson:
+        score = self.scores.best()
+        assert score is not None, "got score"
+        pv = self.pvs.best() or []
+        return AnalysisPart.best(
+            pv=list(pv),
+            score=score,
+            depth=self.depth,
+            nodes=self.nodes,
+            time_ms=int(self.time_seconds * 1000),
+            nps=self.nps,
+        )
+
+    def into_matrix(self) -> AnalysisPartJson:
+        return AnalysisPart.matrix(
+            pv=self.pvs.to_json(),
+            score=self.scores.to_json(),
+            depth=self.depth,
+            nodes=self.nodes,
+            time_ms=int(self.time_seconds * 1000),
+            nps=self.nps,
+        )
+
+
+@dataclass(frozen=True)
+class PositionFailed:
+    batch_id: str
+
+
+class EngineError(Exception):
+    """Engine-tier failure while searching a position."""

@@ -1,0 +1,29 @@
+"""Randomized exponential backoff.
+
+Behavioral equivalent of the reference's RandomizedBackoff
+(src/util.rs:10-37): draw uniformly from [100ms, 4 * max(100ms, last)),
+then cap at the configured maximum (default 30s). Used for acquire
+polling, engine restarts, and API error handling.
+"""
+
+from __future__ import annotations
+
+import random
+
+_LOW = 0.1  # 100 ms
+
+
+class RandomizedBackoff:
+    def __init__(self, max_backoff_seconds: float = 30.0) -> None:
+        self.max_backoff = max(_LOW, max_backoff_seconds)
+        self._last = 0.0
+
+    def next(self) -> float:
+        """Return the next backoff duration in seconds."""
+        high = 4.0 * max(_LOW, self._last)
+        duration = min(self.max_backoff, random.uniform(_LOW, high))
+        self._last = duration
+        return duration
+
+    def reset(self) -> None:
+        self._last = 0.0

@@ -1,0 +1,23 @@
+"""Version of the fishnet-tpu client.
+
+``__version__`` identifies this implementation (User-Agent only);
+``PROTOCOL_VERSION`` is what goes in the ``fishnet.version`` request
+field, because lila gates clients by that version
+(reference: src/api.rs:108-115, doc/protocol.md:240-244).
+"""
+
+__version__ = "0.1.0"
+
+#: Version string reported on the wire. The lichess server gates clients by
+#: version (400/406 responses, doc/protocol.md:240-244); we report a
+#: fishnet-compatible version so a real server applies the same gating rules
+#: it would to the reference client.
+PROTOCOL_VERSION = "2.6.8"
+
+
+def user_agent() -> str:
+    import platform
+
+    return "fishnet-tpu-{}-{}/{}".format(
+        platform.system().lower(), platform.machine(), __version__
+    )

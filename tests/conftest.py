@@ -1,0 +1,19 @@
+"""Test configuration.
+
+Tests run on CPU with a virtual 8-device platform so that every sharding
+path (mesh construction, pjit/shard_map collectives) is exercised without
+TPU hardware. This must be set before jax is first imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

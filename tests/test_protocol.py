@@ -1,0 +1,151 @@
+"""Wire-model tests: parse the exact JSON bodies from doc/protocol.md and
+check serialization quirks the lichess server depends on."""
+
+import pytest
+
+from fishnet_tpu.protocol.types import (
+    AcquireResponseBody,
+    AnalysisPart,
+    EvalFlavor,
+    NodeLimit,
+    ProtocolError,
+    Score,
+    SkillLevel,
+    Variant,
+    Work,
+    analysis_request_body,
+    move_request_body,
+)
+
+ANALYSIS_ACQUIRE = {
+    "work": {
+        "type": "analysis",
+        "id": "work_id",
+        "nodes": {"sf15": 1500000, "sf14": 2100000, "classical": 4050000},
+        "timeout": 7000,
+    },
+    "game_id": "abcdefgh",
+    "position": "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+    "variant": "standard",
+    "moves": "e2e4 c7c5 c2c4 b8c6 g1e2 g8f6 b1c3 c6b4 g2g3 b4d3",
+    "skipPositions": [1, 4, 5],
+}
+
+MOVE_ACQUIRE = {
+    "work": {
+        "type": "move",
+        "id": "work_id",
+        "level": 5,
+        "clock": {"wtime": 18000, "btime": 18000, "inc": 2},
+    },
+    "game_id": "",
+    "position": "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+    "variant": "standard",
+    "moves": "",
+}
+
+
+def test_parse_analysis_acquire():
+    body = AcquireResponseBody.from_json(ANALYSIS_ACQUIRE)
+    assert body.work.is_analysis
+    assert body.work.id == "work_id"
+    assert body.work.nodes.get(EvalFlavor.NNUE) == 1500000
+    assert body.work.nodes.get(EvalFlavor.HCE) == 4050000
+    assert body.work.timeout_seconds() == 7.0
+    assert body.work.effective_multipv() == 1
+    assert not body.work.matrix_wanted
+    assert body.variant is Variant.STANDARD
+    assert len(body.moves) == 10
+    assert body.moves[0] == "e2e4"
+    assert body.skip_positions == [1, 4, 5]
+    assert body.game_id == "abcdefgh"
+    assert body.batch_url("https://lichess.org/fishnet") == "https://lichess.org/abcdefgh"
+
+
+def test_parse_move_acquire():
+    body = AcquireResponseBody.from_json(MOVE_ACQUIRE)
+    assert body.work.is_move
+    assert body.work.level is SkillLevel.FIVE
+    assert body.work.level.movetime_ms() == 300
+    assert body.work.level.skill_level() == 7
+    assert body.work.level.depth() == 5
+    assert body.work.clock.wtime_ms == 180000
+    assert body.work.clock.inc_ms == 2000
+    assert body.work.timeout_seconds() == 2.0
+    assert body.game_id is None  # empty string -> absent
+    assert body.moves == []
+
+
+def test_multipv_and_depth_optional():
+    data = dict(ANALYSIS_ACQUIRE)
+    data["work"] = dict(ANALYSIS_ACQUIRE["work"], multipv=3, depth=20)
+    body = AcquireResponseBody.from_json(data)
+    assert body.work.effective_multipv() == 3
+    assert body.work.matrix_wanted
+    assert body.work.depth == 20
+
+
+def test_skill_level_tables():
+    assert SkillLevel.ONE.movetime_ms() == 50
+    assert SkillLevel.EIGHT.movetime_ms() == 1000
+    assert SkillLevel.ONE.skill_level() == -9
+    assert SkillLevel.EIGHT.skill_level() == 20
+    assert SkillLevel.SEVEN.depth() == 13
+    assert SkillLevel.EIGHT.depth() == 22
+
+
+def test_batch_id_capacity():
+    data = dict(ANALYSIS_ACQUIRE)
+    data["work"] = dict(ANALYSIS_ACQUIRE["work"], id="x" * 25)
+    with pytest.raises(ProtocolError):
+        AcquireResponseBody.from_json(data)
+
+
+def test_variant_aliases():
+    assert Variant.parse("chess960").is_standard
+    assert Variant.parse("fromPosition").is_standard
+    assert Variant.parse("threeCheck") is Variant.THREE_CHECK
+    assert Variant.parse(None).is_standard
+    with pytest.raises(ProtocolError):
+        Variant.parse("shogi")
+
+
+def test_analysis_part_best_serialization():
+    part = AnalysisPart.best(
+        pv=["e2e4", "e7e5"], score=Score.cp(24), depth=18, nodes=1686023,
+        time_ms=1004, nps=1670251,
+    )
+    assert part == {
+        "pv": "e2e4 e7e5",
+        "score": {"cp": 24},
+        "depth": 18,
+        "nodes": 1686023,
+        "time": 1004,
+        "nps": 1670251,
+    }
+    # Empty pv and unknown nps are omitted (api.rs:361-369).
+    part = AnalysisPart.best(pv=[], score=Score.mate(0), depth=0, nodes=0, time_ms=0)
+    assert part == {"score": {"mate": 0}, "depth": 0, "nodes": 0, "time": 0}
+
+
+def test_analysis_request_body_shape():
+    body = analysis_request_body(
+        "2.6.8", "KEY", EvalFlavor.NNUE,
+        [AnalysisPart.skipped(), None, AnalysisPart.best([], Score.cp(1), 1, 2, 3)],
+    )
+    assert body["fishnet"] == {"version": "2.6.8", "apikey": "KEY"}
+    assert body["stockfish"] == {"flavor": "nnue"}
+    assert body["analysis"][0] == {"skipped": True}
+    assert body["analysis"][1] is None
+
+
+def test_move_request_body():
+    assert move_request_body("2.6.8", None, "b7b8q") == {
+        "fishnet": {"version": "2.6.8", "apikey": ""},
+        "move": {"bestmove": "b7b8q"},
+    }
+
+
+def test_node_limit_requires_both_fields():
+    with pytest.raises(ProtocolError):
+        NodeLimit.from_json({"sf15": 1})

@@ -1,0 +1,68 @@
+from pathlib import Path
+
+from fishnet_tpu.utils.backoff import RandomizedBackoff
+from fishnet_tpu.utils.logger import QueueStatusBar, short_variant_name
+from fishnet_tpu.utils.stats import NpsRecorder, StatsRecorder
+
+
+def test_backoff_bounds_and_growth():
+    b = RandomizedBackoff(max_backoff_seconds=30.0)
+    first = b.next()
+    assert 0.1 <= first <= 0.4
+    for _ in range(50):
+        d = b.next()
+        assert 0.1 <= d <= 30.0
+    b.reset()
+    assert 0.1 <= b.next() <= 0.4
+
+
+def test_backoff_cap():
+    b = RandomizedBackoff(max_backoff_seconds=0.2)
+    for _ in range(20):
+        assert b.next() <= 0.2
+
+
+def test_nps_recorder_ewma():
+    r = NpsRecorder(cores=2)
+    assert r.nps == 800_000
+    assert "?" in str(r)
+    for _ in range(60):
+        r.record(20_000_000)
+    assert r.nps > 15_000_000
+    assert "?" not in str(r)
+
+
+def test_stats_persistence(tmp_path: Path):
+    path = tmp_path / "stats.json"
+    rec = StatsRecorder(cores=1, stats_file=path)
+    rec.record_batch(60, 120_000_000, nnue_nps=1_000_000)
+    rec2 = StatsRecorder(cores=1, stats_file=path)
+    assert rec2.stats.total_batches == 1
+    assert rec2.stats.total_positions == 60
+    assert rec2.stats.total_nodes == 120_000_000
+
+
+def test_stats_corrupt_file_resets(tmp_path: Path):
+    path = tmp_path / "stats.json"
+    path.write_text("{not json")
+    rec = StatsRecorder(cores=1, stats_file=path)
+    assert rec.stats.total_batches == 0
+
+
+def test_min_user_backlog_scales_with_speed():
+    slow = StatsRecorder(cores=1, no_stats_file=True)
+    assert slow.min_user_backlog() > 0  # 400 knps client should self-select out
+    fast = StatsRecorder(cores=1, no_stats_file=True)
+    for _ in range(100):
+        fast.nnue_nps.record(50_000_000)
+    assert fast.min_user_backlog() == 0.0
+
+
+def test_queue_status_bar():
+    bar = str(QueueStatusBar(pending=10, cores=4))
+    assert bar.startswith("[") and "10" in bar
+
+
+def test_short_variant_names():
+    assert short_variant_name("crazyhouse") == "zh"
+    assert short_variant_name("standard") is None
