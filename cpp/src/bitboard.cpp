@@ -1,0 +1,164 @@
+#include "bitboard.h"
+
+#include <cstring>
+#include <vector>
+
+#ifdef __BMI2__
+#include <immintrin.h>
+#endif
+
+namespace fc {
+
+Bitboard KNIGHT_ATTACKS[64];
+Bitboard KING_ATTACKS[64];
+Bitboard PAWN_ATTACKS[COLOR_NB][64];
+Bitboard BETWEEN[64][64];
+Bitboard LINE[64][64];
+
+namespace {
+
+// Step a square by (df, dr); SQ_NONE if off board.
+Square step(Square s, int df, int dr) {
+  int f = file_of(s) + df, r = rank_of(s) + dr;
+  if (f < 0 || f > 7 || r < 0 || r > 7) return SQ_NONE;
+  return make_square(f, r);
+}
+
+Bitboard ray_attacks(Square s, Bitboard occ, const int dirs[4][2]) {
+  Bitboard attacks = 0;
+  for (int d = 0; d < 4; d++) {
+    Square cur = s;
+    while (true) {
+      cur = step(cur, dirs[d][0], dirs[d][1]);
+      if (cur == SQ_NONE) break;
+      attacks |= bb(cur);
+      if (occ & bb(cur)) break;
+    }
+  }
+  return attacks;
+}
+
+const int ROOK_DIRS[4][2] = {{1, 0}, {-1, 0}, {0, 1}, {0, -1}};
+const int BISHOP_DIRS[4][2] = {{1, 1}, {1, -1}, {-1, 1}, {-1, -1}};
+
+Bitboard slow_rook(Square s, Bitboard occ) { return ray_attacks(s, occ, ROOK_DIRS); }
+Bitboard slow_bishop(Square s, Bitboard occ) { return ray_attacks(s, occ, BISHOP_DIRS); }
+
+#ifdef __BMI2__
+// PEXT tables: per square, relevant-occupancy mask and a dense table indexed
+// by _pext_u64(occ, mask).
+struct PextEntry {
+  Bitboard mask;
+  const Bitboard* table;
+};
+
+PextEntry ROOK_PEXT[64];
+PextEntry BISHOP_PEXT[64];
+std::vector<Bitboard> PEXT_STORAGE;
+
+Bitboard relevant_mask(Square s, bool rook) {
+  // Attacks on an empty board, minus board-edge squares (a blocker on the
+  // edge can't shadow anything further).
+  Bitboard edges = ((RANK_1_BB | rank_bb(7)) & ~rank_bb(rank_of(s))) |
+                   ((FILE_A_BB | file_bb(7)) & ~file_bb(file_of(s)));
+  Bitboard att = rook ? slow_rook(s, 0) : slow_bishop(s, 0);
+  return att & ~edges;
+}
+
+void init_pext() {
+  // Total table size: sum over squares of 2^popcount(mask):
+  // rooks 102400 + bishops 5248 entries.
+  size_t total = 0;
+  for (int rook = 0; rook < 2; rook++)
+    for (Square s = 0; s < 64; s++)
+      total += 1ULL << popcount(relevant_mask(s, rook));
+  PEXT_STORAGE.resize(total);
+
+  size_t offset = 0;
+  for (int rook = 0; rook < 2; rook++) {
+    for (Square s = 0; s < 64; s++) {
+      Bitboard mask = relevant_mask(s, rook);
+      PextEntry& e = (rook ? ROOK_PEXT : BISHOP_PEXT)[s];
+      e.mask = mask;
+      e.table = &PEXT_STORAGE[offset];
+      // Enumerate all subsets of mask (Carry-Rippler iteration).
+      Bitboard sub = 0;
+      do {
+        PEXT_STORAGE[offset + _pext_u64(sub, mask)] =
+            rook ? slow_rook(s, sub) : slow_bishop(s, sub);
+        sub = (sub - mask) & mask;
+      } while (sub);
+      offset += 1ULL << popcount(mask);
+    }
+  }
+}
+#endif  // __BMI2__
+
+}  // namespace
+
+Bitboard rook_attacks(Square s, Bitboard occ) {
+#ifdef __BMI2__
+  const auto& e = ROOK_PEXT[s];
+  return e.table[_pext_u64(occ, e.mask)];
+#else
+  return slow_rook(s, occ);
+#endif
+}
+
+Bitboard bishop_attacks(Square s, Bitboard occ) {
+#ifdef __BMI2__
+  const auto& e = BISHOP_PEXT[s];
+  return e.table[_pext_u64(occ, e.mask)];
+#else
+  return slow_bishop(s, occ);
+#endif
+}
+
+void init_bitboards() {
+  static bool done = false;
+  if (done) return;
+  done = true;
+
+  const int knight_steps[8][2] = {{1, 2},  {2, 1},  {2, -1}, {1, -2},
+                                  {-1, -2}, {-2, -1}, {-2, 1}, {-1, 2}};
+  const int king_steps[8][2] = {{1, 0},  {1, 1},  {0, 1},  {-1, 1},
+                                {-1, 0}, {-1, -1}, {0, -1}, {1, -1}};
+
+  for (Square s = 0; s < 64; s++) {
+    KNIGHT_ATTACKS[s] = 0;
+    KING_ATTACKS[s] = 0;
+    for (auto& st : knight_steps)
+      if (Square t = step(s, st[0], st[1]); t != SQ_NONE) KNIGHT_ATTACKS[s] |= bb(t);
+    for (auto& st : king_steps)
+      if (Square t = step(s, st[0], st[1]); t != SQ_NONE) KING_ATTACKS[s] |= bb(t);
+
+    PAWN_ATTACKS[WHITE][s] = 0;
+    PAWN_ATTACKS[BLACK][s] = 0;
+    if (Square t = step(s, 1, 1); t != SQ_NONE) PAWN_ATTACKS[WHITE][s] |= bb(t);
+    if (Square t = step(s, -1, 1); t != SQ_NONE) PAWN_ATTACKS[WHITE][s] |= bb(t);
+    if (Square t = step(s, 1, -1); t != SQ_NONE) PAWN_ATTACKS[BLACK][s] |= bb(t);
+    if (Square t = step(s, -1, -1); t != SQ_NONE) PAWN_ATTACKS[BLACK][s] |= bb(t);
+  }
+
+#ifdef __BMI2__
+  init_pext();
+#endif
+
+  // BETWEEN / LINE tables from slider geometry.
+  for (Square a = 0; a < 64; a++) {
+    for (Square b = 0; b < 64; b++) {
+      BETWEEN[a][b] = 0;
+      LINE[a][b] = 0;
+      if (a == b) continue;
+      if (slow_rook(a, 0) & bb(b)) {
+        BETWEEN[a][b] = slow_rook(a, bb(b)) & slow_rook(b, bb(a));
+        LINE[a][b] = (slow_rook(a, 0) & slow_rook(b, 0)) | bb(a) | bb(b);
+      } else if (slow_bishop(a, 0) & bb(b)) {
+        BETWEEN[a][b] = slow_bishop(a, bb(b)) & slow_bishop(b, bb(a));
+        LINE[a][b] = (slow_bishop(a, 0) & slow_bishop(b, 0)) | bb(a) | bb(b);
+      }
+    }
+  }
+}
+
+}  // namespace fc

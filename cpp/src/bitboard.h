@@ -1,0 +1,32 @@
+// Attack tables. Sliding-piece attacks use PEXT-indexed lookup tables when
+// compiled with BMI2 (no magic constants needed), with a portable ray-scan
+// fallback otherwise.
+
+#pragma once
+
+#include "types.h"
+
+namespace fc {
+
+extern Bitboard KNIGHT_ATTACKS[64];
+extern Bitboard KING_ATTACKS[64];
+extern Bitboard PAWN_ATTACKS[COLOR_NB][64];
+// between_incl[a][b]: squares strictly between a and b (empty if not aligned);
+// line[a][b]: full line through a and b (empty if not aligned).
+extern Bitboard BETWEEN[64][64];
+extern Bitboard LINE[64][64];
+
+void init_bitboards();
+
+Bitboard rook_attacks(Square s, Bitboard occ);
+Bitboard bishop_attacks(Square s, Bitboard occ);
+
+inline Bitboard queen_attacks(Square s, Bitboard occ) {
+  return rook_attacks(s, occ) | bishop_attacks(s, occ);
+}
+
+inline Bitboard pawn_pushes(Color c, Bitboard pawns, Bitboard empty) {
+  return c == WHITE ? ((pawns << 8) & empty) : ((pawns >> 8) & empty);
+}
+
+}  // namespace fc

@@ -1,0 +1,101 @@
+// C ABI for the native core, consumed from Python via ctypes
+// (fishnet_tpu/chess/core.py). Kept deliberately string-based at the
+// boundary (FEN in, UCI out) so the Python side stays simple; the hot
+// search path never crosses this boundary per-node.
+
+#include <cstring>
+#include <new>
+#include <string>
+
+#include "position.h"
+
+using namespace fc;
+
+namespace {
+
+int copy_out(const std::string& s, char* buf, int len) {
+  if (!buf || len <= 0) return -1;
+  if (int(s.size()) + 1 > len) return -1;
+  memcpy(buf, s.c_str(), s.size() + 1);
+  return int(s.size());
+}
+
+// Only standard chess (incl. Chess960) has complete rules so far; other
+// variants are scaffolding and stay gated off until their rule deltas and
+// perft suites land.
+bool variant_supported(int variant) { return variant == VR_STANDARD; }
+
+}  // namespace
+
+extern "C" {
+
+int fc_init() {
+  init_bitboards();
+  init_zobrist();
+  return 0;
+}
+
+int fc_variant_supported(int variant) { return variant_supported(variant) ? 1 : 0; }
+
+Position* fc_pos_new(const char* fen, int variant, char* err, int errlen) {
+  if (!variant_supported(variant)) {
+    if (err) copy_out("unsupported variant", err, errlen);
+    return nullptr;
+  }
+  Position* pos = new (std::nothrow) Position();
+  if (!pos) return nullptr;
+  std::string e = pos->set_fen(fen ? fen : "", VariantRules(variant));
+  if (!e.empty()) {
+    if (err) copy_out(e, err, errlen);
+    delete pos;
+    return nullptr;
+  }
+  return pos;
+}
+
+Position* fc_pos_clone(const Position* pos) {
+  return pos ? new (std::nothrow) Position(*pos) : nullptr;
+}
+
+void fc_pos_free(Position* pos) { delete pos; }
+
+int fc_pos_play_uci(Position* pos, const char* uci) {
+  Move m = pos->parse_uci(uci ? uci : "");
+  if (m == MOVE_NONE) return -1;
+  pos->make(m);
+  return 0;
+}
+
+int fc_pos_fen(const Position* pos, char* buf, int len) {
+  return copy_out(pos->fen(), buf, len);
+}
+
+int fc_pos_turn(const Position* pos) { return int(pos->stm); }
+
+int fc_pos_is_check(const Position* pos) { return pos->in_check() ? 1 : 0; }
+
+int fc_pos_halfmove(const Position* pos) { return pos->halfmove; }
+
+int fc_pos_fullmove(const Position* pos) { return pos->fullmove; }
+
+unsigned long long fc_pos_hash(const Position* pos) { return pos->hash; }
+
+int fc_pos_outcome(const Position* pos) { return pos->outcome(); }
+
+// Space-separated UCI strings of all legal moves.
+int fc_pos_legal_moves(const Position* pos, char* buf, int len) {
+  MoveList legal;
+  pos->legal_moves(legal);
+  std::string out;
+  for (Move m : legal) {
+    if (!out.empty()) out += ' ';
+    out += pos->uci(m);
+  }
+  return copy_out(out, buf, len);
+}
+
+unsigned long long fc_perft(const Position* pos, int depth) {
+  return perft(*pos, depth);
+}
+
+}  // extern "C"

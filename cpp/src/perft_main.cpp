@@ -1,0 +1,78 @@
+// Standalone perft runner: ./perft [depth] ["fen"] — prints node count,
+// or runs the built-in validation suite with no args.
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+
+#include "position.h"
+
+using namespace fc;
+
+struct Case {
+  const char* name;
+  const char* fen;
+  int depth;
+  uint64_t nodes;
+};
+
+// Standard perft suite (positions and counts are community-standard test
+// vectors, e.g. from the chessprogramming wiki perft results page).
+static const Case SUITE[] = {
+    {"startpos d5", "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1", 5,
+     4865609ULL},
+    {"kiwipete d4",
+     "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1", 4,
+     4085603ULL},
+    {"endgame d6", "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1", 6, 11030083ULL},
+    {"promo d5", "r3k2r/Pppp1ppp/1b3nbN/nP6/BBP1P3/q4N2/Pp1P2PP/R2Q1RK1 w kq - 0 1",
+     5, 15833292ULL},
+    {"pos5 d4", "rnbq1k1r/pp1Pbppp/2p5/8/2B5/8/PPP1NnPP/RNBQK2R w KQ - 1 8", 4,
+     2103487ULL},
+    {"pos6 d4",
+     "r4rk1/1pp1qppp/p1np1n2/2b1p1B1/2B1P1b1/P1NP1N2/1PP1QPPP/R4RK1 w - - 0 10", 4,
+     3894594ULL},
+};
+
+int main(int argc, char** argv) {
+  init_bitboards();
+  init_zobrist();
+
+  if (argc >= 2) {
+    int depth = atoi(argv[1]);
+    const char* fen = argc >= 3 ? argv[2]
+                                : "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1";
+    Position pos;
+    std::string err = pos.set_fen(fen, VR_STANDARD);
+    if (!err.empty()) {
+      fprintf(stderr, "bad fen: %s\n", err.c_str());
+      return 1;
+    }
+    auto t0 = std::chrono::steady_clock::now();
+    uint64_t nodes = perft(pos, depth);
+    auto dt = std::chrono::duration<double>(std::chrono::steady_clock::now() - t0).count();
+    printf("perft(%d) = %llu  (%.2fs, %.1f Mnps)\n", depth, (unsigned long long)nodes,
+           dt, nodes / dt / 1e6);
+    return 0;
+  }
+
+  int failures = 0;
+  for (const Case& c : SUITE) {
+    Position pos;
+    std::string err = pos.set_fen(c.fen, VR_STANDARD);
+    if (!err.empty()) {
+      printf("FAIL %-12s bad fen: %s\n", c.name, err.c_str());
+      failures++;
+      continue;
+    }
+    auto t0 = std::chrono::steady_clock::now();
+    uint64_t nodes = perft(pos, c.depth);
+    auto dt = std::chrono::duration<double>(std::chrono::steady_clock::now() - t0).count();
+    bool ok = nodes == c.nodes;
+    printf("%s %-12s got %llu want %llu  (%.2fs, %.1f Mnps)\n", ok ? "ok  " : "FAIL",
+           c.name, (unsigned long long)nodes, (unsigned long long)c.nodes, dt,
+           nodes / dt / 1e6);
+    failures += !ok;
+  }
+  return failures ? 1 : 0;
+}

@@ -1,0 +1,688 @@
+#include "position.h"
+
+#include <cctype>
+#include <cstring>
+#include <sstream>
+
+namespace fc {
+
+// ---------------------------------------------------------------------------
+// Zobrist keys, generated deterministically with splitmix64.
+// ---------------------------------------------------------------------------
+
+namespace zobrist {
+uint64_t piece_sq[12][64];
+uint64_t castling_rook[64];
+uint64_t ep_file[8];
+uint64_t black_to_move;
+uint64_t checks[COLOR_NB][4];
+uint64_t hand_piece[COLOR_NB][PIECE_TYPE_NB][17];
+}  // namespace zobrist
+
+static uint64_t splitmix64(uint64_t& x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  uint64_t z = x;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+void init_zobrist() {
+  static bool done = false;
+  if (done) return;
+  done = true;
+  uint64_t seed = 0x5EEDFEEDC0FFEE42ULL;
+  for (auto& arr : zobrist::piece_sq)
+    for (auto& v : arr) v = splitmix64(seed);
+  for (auto& v : zobrist::castling_rook) v = splitmix64(seed);
+  for (auto& v : zobrist::ep_file) v = splitmix64(seed);
+  zobrist::black_to_move = splitmix64(seed);
+  for (auto& arr : zobrist::checks)
+    for (auto& v : arr) v = splitmix64(seed);
+  for (auto& c : zobrist::hand_piece)
+    for (auto& p : c)
+      for (auto& v : p) v = splitmix64(seed);
+}
+
+// ---------------------------------------------------------------------------
+// Board manipulation
+// ---------------------------------------------------------------------------
+
+void Position::put_piece(Square s, int pc) {
+  board[s] = uint8_t(pc);
+  by_color[piece_color(pc)] |= bb(s);
+  by_type[piece_type(pc)] |= bb(s);
+  hash ^= zobrist::piece_sq[pc][s];
+}
+
+void Position::remove_piece(Square s) {
+  int pc = board[s];
+  board[s] = NO_PIECE;
+  by_color[piece_color(pc)] &= ~bb(s);
+  by_type[piece_type(pc)] &= ~bb(s);
+  hash ^= zobrist::piece_sq[pc][s];
+}
+
+Bitboard Position::attackers_to(Square s, Bitboard occ) const {
+  return (PAWN_ATTACKS[WHITE][s] & pieces(BLACK, PAWN)) |
+         (PAWN_ATTACKS[BLACK][s] & pieces(WHITE, PAWN)) |
+         (KNIGHT_ATTACKS[s] & by_type[KNIGHT]) |
+         (KING_ATTACKS[s] & by_type[KING]) |
+         (rook_attacks(s, occ) & (by_type[ROOK] | by_type[QUEEN])) |
+         (bishop_attacks(s, occ) & (by_type[BISHOP] | by_type[QUEEN]));
+}
+
+uint64_t Position::compute_hash() const {
+  uint64_t h = 0;
+  for (Square s = 0; s < 64; s++)
+    if (board[s] != NO_PIECE) h ^= zobrist::piece_sq[board[s]][s];
+  Bitboard cr = castling_rooks;
+  while (cr) h ^= zobrist::castling_rook[pop_lsb(cr)];
+  if (ep_square != SQ_NONE) h ^= zobrist::ep_file[file_of(ep_square)];
+  if (stm == BLACK) h ^= zobrist::black_to_move;
+  for (Color c : {WHITE, BLACK}) {
+    if (checks_given[c]) h ^= zobrist::checks[c][checks_given[c] & 3];
+    for (int pt = PAWN; pt < PIECE_TYPE_NB; pt++)
+      if (hand[c][pt]) h ^= zobrist::hand_piece[c][pt][hand[c][pt]];
+  }
+  return h;
+}
+
+// ---------------------------------------------------------------------------
+// FEN
+// ---------------------------------------------------------------------------
+
+static const char PIECE_CHARS[] = "PNBRQKpnbrqk";
+
+static int piece_from_char(char c) {
+  const char* p = strchr(PIECE_CHARS, c);
+  return p && c ? int(p - PIECE_CHARS) : NO_PIECE;
+}
+
+static std::string square_name(Square s) {
+  std::string out;
+  out += char('a' + file_of(s));
+  out += char('1' + rank_of(s));
+  return out;
+}
+
+static Square parse_square(const std::string& s) {
+  if (s.size() != 2 || s[0] < 'a' || s[0] > 'h' || s[1] < '1' || s[1] > '8')
+    return SQ_NONE;
+  return make_square(s[0] - 'a', s[1] - '1');
+}
+
+std::string Position::set_fen(const std::string& fen, VariantRules var) {
+  init_bitboards();
+  init_zobrist();
+
+  *this = Position();
+  variant = var;
+  memset(board, NO_PIECE, sizeof(board));
+
+  std::istringstream ss(fen);
+  std::string placement, turn, castling, ep, half, full;
+  ss >> placement >> turn >> castling >> ep >> half >> full;
+  if (placement.empty()) return "empty FEN";
+  if (turn.empty()) turn = "w";
+  if (castling.empty()) castling = "-";
+  if (ep.empty()) ep = "-";
+
+  // Piece placement. Lichess crazyhouse FENs may carry a pocket either as
+  // an extra rank ("...8/PPPP[QRq]") or bracket suffix; accept "[...]".
+  std::string pocket;
+  size_t lb = placement.find('[');
+  if (lb != std::string::npos) {
+    size_t rb = placement.find(']', lb);
+    if (rb == std::string::npos) return "unterminated pocket";
+    pocket = placement.substr(lb + 1, rb - lb - 1);
+    placement = placement.substr(0, lb);
+  }
+
+  int rank = 7, file = 0;
+  for (size_t i = 0; i < placement.size(); i++) {
+    char c = placement[i];
+    if (c == '/') {
+      if (file != 8) return "bad rank length";
+      rank--;
+      file = 0;
+      if (rank < 0) return "too many ranks";
+    } else if (isdigit(c)) {
+      file += c - '0';
+      if (file > 8) return "bad file count";
+    } else if (c == '~') {
+      // promoted-piece marker (crazyhouse): piece already placed; record
+      // nothing for now (promoted pieces drop back as pawns — tracked when
+      // crazyhouse rules land).
+      if (file == 0) return "misplaced ~";
+    } else {
+      int pc = piece_from_char(c);
+      if (pc == NO_PIECE || file > 7 || rank < 0) return "bad piece placement";
+      put_piece(make_square(file, rank), pc);
+      file++;
+    }
+  }
+  if (rank != 0 || file != 8) return "incomplete placement";
+
+  for (char c : pocket) {
+    int pc = piece_from_char(c);
+    if (pc == NO_PIECE) return "bad pocket piece";
+    hand[piece_color(pc)][piece_type(pc)]++;
+  }
+
+  if (turn == "w")
+    stm = WHITE;
+  else if (turn == "b")
+    stm = BLACK;
+  else
+    return "bad side to move";
+
+  // Castling rights: K/Q/k/q (X-FEN: outermost rook on that side) or
+  // file letters A-H / a-h (Shredder-FEN).
+  if (castling != "-") {
+    for (char c : castling) {
+      Color color = isupper(c) ? WHITE : BLACK;
+      int home_rank = color == WHITE ? 0 : 7;
+      Square ksq = king_sq(color);
+      if (ksq == SQ_NONE || rank_of(ksq) != home_rank) return "castling without king";
+      char u = char(toupper(c));
+      Square rook = SQ_NONE;
+      Bitboard rooks = pieces(color, ROOK) & rank_bb(home_rank);
+      if (u == 'K') {
+        Bitboard right = rooks & ~(bb(ksq) - 1) & ~bb(ksq);
+        if (right) rook = msb(right);  // outermost kingside rook
+      } else if (u == 'Q') {
+        Bitboard left = rooks & (bb(ksq) - 1);
+        if (left) rook = lsb(left);  // outermost queenside rook
+      } else if (u >= 'A' && u <= 'H') {
+        Square cand = make_square(u - 'A', home_rank);
+        if (rooks & bb(cand)) rook = cand;
+      } else {
+        return "bad castling field";
+      }
+      if (rook == SQ_NONE) return "castling right without rook";
+      castling_rooks |= bb(rook);
+    }
+  }
+
+  if (ep != "-") {
+    Square s = parse_square(ep);
+    if (s == SQ_NONE) return "bad en passant square";
+    ep_square = s;
+    if (!ep_capture_legal()) ep_square = SQ_NONE;
+  }
+
+  halfmove = half.empty() ? 0 : atoi(half.c_str());
+  fullmove = full.empty() ? 1 : std::max(1, atoi(full.c_str()));
+
+  // Basic sanity: both kings present (variants relax this later).
+  if (variant != VR_ANTICHESS && variant != VR_HORDE) {
+    if (popcount(pieces(WHITE, KING)) != 1 || popcount(pieces(BLACK, KING)) != 1)
+      return "kings missing";
+    // Side not to move must not be in check (illegal position).
+    Square k = king_sq(~stm);
+    if (k != SQ_NONE && attacked_by(k, stm, occupied()))
+      return "side not to move is in check";
+  } else if (variant == VR_HORDE) {
+    if (popcount(pieces(BLACK, KING)) != 1) return "kings missing";
+  }
+
+  hash = compute_hash();
+  return "";
+}
+
+std::string Position::fen() const {
+  std::ostringstream out;
+  for (int r = 7; r >= 0; r--) {
+    int run = 0;
+    for (int f = 0; f < 8; f++) {
+      int pc = board[make_square(f, r)];
+      if (pc == NO_PIECE) {
+        run++;
+      } else {
+        if (run) out << run;
+        run = 0;
+        out << PIECE_CHARS[pc];
+      }
+    }
+    if (run) out << run;
+    if (r) out << '/';
+  }
+
+  if (variant == VR_CRAZYHOUSE) {
+    out << '[';
+    for (Color c : {WHITE, BLACK})
+      for (int pt = QUEEN; pt >= PAWN; pt--)
+        for (int i = 0; i < hand[c][pt]; i++)
+          out << PIECE_CHARS[make_piece(c, PieceType(pt))];
+    out << ']';
+  }
+
+  out << (stm == WHITE ? " w " : " b ");
+
+  if (!castling_rooks) {
+    out << '-';
+  } else {
+    // X-FEN: K/Q when the rook is the outermost one on its side, else the
+    // rook's file letter.
+    std::string rights;
+    for (Color c : {WHITE, BLACK}) {
+      int home_rank = c == WHITE ? 0 : 7;
+      Square ksq = king_sq(c);
+      Bitboard rooks_here = castling_rooks & by_color[c];
+      std::vector<Square> sqs;
+      Bitboard tmp = rooks_here;
+      while (tmp) sqs.push_back(pop_lsb(tmp));
+      // Emit kingside first, then queenside (descending file order).
+      for (auto it = sqs.rbegin(); it != sqs.rend(); ++it) {
+        Square rsq = *it;
+        Bitboard all_rooks = pieces(c, ROOK) & rank_bb(home_rank);
+        char letter;
+        if (rsq > ksq) {
+          Bitboard outer = all_rooks & ~(bb(rsq) | (bb(rsq) - 1));
+          letter = outer ? char('A' + file_of(rsq)) : 'K';
+        } else {
+          Bitboard outer = all_rooks & (bb(rsq) - 1);
+          letter = outer ? char('A' + file_of(rsq)) : 'Q';
+        }
+        rights += c == WHITE ? letter : char(tolower(letter));
+      }
+    }
+    out << rights;
+  }
+
+  out << ' ' << (ep_square == SQ_NONE ? "-" : square_name(ep_square));
+
+  if (variant == VR_THREE_CHECK)
+    // Lichess three-check FEN carries remaining checks as "+W+B".
+    out << ' ' << '+' << (3 - checks_given[WHITE]) << '+' << (3 - checks_given[BLACK]);
+
+  out << ' ' << halfmove << ' ' << fullmove;
+  return out.str();
+}
+
+// ---------------------------------------------------------------------------
+// Move generation
+// ---------------------------------------------------------------------------
+
+bool Position::castle_path_ok(Square kfrom, Square rfrom) const {
+  Color us = stm;
+  bool kingside = rfrom > kfrom;
+  Square kto = make_square(kingside ? 6 : 2, rank_of(kfrom));
+  Square rto = make_square(kingside ? 5 : 3, rank_of(kfrom));
+
+  Bitboard occ_wo = occupied() & ~bb(kfrom) & ~bb(rfrom);
+
+  // All squares the king or rook pass through or land on must be empty
+  // (ignoring the king and rook themselves).
+  Bitboard kpath = BETWEEN[kfrom][kto] | bb(kto);
+  Bitboard rpath = BETWEEN[rfrom][rto] | bb(rto);
+  if ((kpath | rpath) & occ_wo) return false;
+
+  // The king may not start from, or traverse, an attacked square.
+  // Intermediate squares are tested with pre-move occupancy minus the king
+  // (the rook has not moved yet). The destination square is deliberately
+  // NOT tested here: is_legal()'s make+check covers it with the true final
+  // occupancy, which handles the Chess960 rook-shelter case (castling rook
+  // leaving its square can expose the king to an attacker behind it).
+  Bitboard attack_check = (BETWEEN[kfrom][kto] | bb(kfrom)) & ~bb(kto);
+  Bitboard occ_traverse = occupied() & ~bb(kfrom);
+  while (attack_check) {
+    Square s = pop_lsb(attack_check);
+    if (attacked_by(s, ~us, occ_traverse)) return false;
+  }
+  return true;
+}
+
+void Position::gen_castling(MoveList& out) const {
+  Color us = stm;
+  Square ksq = king_sq(us);
+  if (ksq == SQ_NONE) return;
+  Bitboard rooks = castling_rooks & by_color[us];
+  while (rooks) {
+    Square rfrom = pop_lsb(rooks);
+    if (castle_path_ok(ksq, rfrom)) out.push(make_move(ksq, rfrom, MK_CASTLE));
+  }
+}
+
+void Position::gen_pseudo(MoveList& out) const {
+  Color us = stm;
+  Color them = ~us;
+  Bitboard occ = occupied();
+  Bitboard targets = ~by_color[us];  // not onto own pieces
+  int up = us == WHITE ? 8 : -8;
+  Bitboard rank3 = rank_bb(us == WHITE ? 2 : 5);
+  Bitboard rank7 = rank_bb(us == WHITE ? 6 : 1);
+
+  // Pawns.
+  Bitboard pawns = pieces(us, PAWN);
+  Bitboard non7 = pawns & ~rank7;
+  Bitboard on7 = pawns & rank7;
+
+  Bitboard single = pawn_pushes(us, non7, ~occ);
+  Bitboard dbl = pawn_pushes(us, single & rank3, ~occ);
+  Bitboard tmp = single;
+  while (tmp) {
+    Square to = pop_lsb(tmp);
+    out.push(make_move(to - up, to));
+  }
+  tmp = dbl;
+  while (tmp) {
+    Square to = pop_lsb(tmp);
+    out.push(make_move(to - 2 * up, to));
+  }
+
+  tmp = non7;
+  while (tmp) {
+    Square from = pop_lsb(tmp);
+    Bitboard caps = PAWN_ATTACKS[us][from] & by_color[them];
+    while (caps) out.push(make_move(from, pop_lsb(caps)));
+    if (ep_square != SQ_NONE && (PAWN_ATTACKS[us][from] & bb(ep_square)))
+      out.push(make_move(from, ep_square, MK_EN_PASSANT));
+  }
+
+  tmp = on7;
+  while (tmp) {
+    Square from = pop_lsb(tmp);
+    Bitboard dests = (PAWN_ATTACKS[us][from] & by_color[them]);
+    if (empty(from + up)) dests |= bb(from + up);
+    while (dests) {
+      Square to = pop_lsb(dests);
+      for (PieceType promo : {QUEEN, KNIGHT, ROOK, BISHOP})
+        out.push(make_move(from, to, MK_NORMAL, promo));
+      if (variant == VR_ANTICHESS) out.push(make_move(from, to, MK_NORMAL, KING));
+    }
+  }
+
+  // Knights / bishops / rooks / queens / king.
+  for (PieceType pt : {KNIGHT, BISHOP, ROOK, QUEEN, KING}) {
+    Bitboard pcs = pieces(us, pt);
+    while (pcs) {
+      Square from = pop_lsb(pcs);
+      Bitboard att;
+      switch (pt) {
+        case KNIGHT: att = KNIGHT_ATTACKS[from]; break;
+        case BISHOP: att = bishop_attacks(from, occ); break;
+        case ROOK: att = rook_attacks(from, occ); break;
+        case QUEEN: att = queen_attacks(from, occ); break;
+        default: att = KING_ATTACKS[from]; break;
+      }
+      att &= targets;
+      while (att) out.push(make_move(from, pop_lsb(att)));
+    }
+  }
+
+  if (variant != VR_ANTICHESS && !in_check()) gen_castling(out);
+
+  // Crazyhouse drops.
+  if (variant == VR_CRAZYHOUSE) {
+    Bitboard empties = ~occ;
+    for (int pt = PAWN; pt < KING; pt++) {
+      if (!hand[us][pt]) continue;
+      Bitboard dests = empties;
+      if (pt == PAWN) dests &= ~(RANK_1_BB | rank_bb(7));
+      Bitboard d = dests;
+      while (d) out.push(make_drop(pop_lsb(d), PieceType(pt)));
+    }
+  }
+}
+
+bool Position::is_legal(Move m) const {
+  // Antichess has no check rules; every generated move is legal (the
+  // capture obligation is enforced during generation).
+  if (variant == VR_ANTICHESS) return true;
+  Position copy = *this;
+  copy.make(m);
+  Square k = copy.king_sq(stm);
+  if (k == SQ_NONE) return variant == VR_ANTICHESS || variant == VR_HORDE;
+  return !copy.attacked_by(k, copy.stm, copy.occupied());
+}
+
+void Position::legal_moves(MoveList& out) const {
+  MoveList pseudo;
+  gen_pseudo(pseudo);
+  for (Move m : pseudo)
+    if (is_legal(m)) out.push(m);
+}
+
+bool Position::ep_capture_legal() const {
+  if (ep_square == SQ_NONE) return false;
+  Bitboard candidates = PAWN_ATTACKS[~stm][ep_square] & pieces(stm, PAWN);
+  while (candidates) {
+    Square from = pop_lsb(candidates);
+    Move m = make_move(from, ep_square, MK_EN_PASSANT);
+    Position copy = *this;
+    copy.make(m);
+    Square k = copy.king_sq(stm);
+    if (k == SQ_NONE || !copy.attacked_by(k, copy.stm, copy.occupied())) return true;
+  }
+  return false;
+}
+
+void Position::make(Move m) {
+  Color us = stm;
+  Color them = ~us;
+  int up = us == WHITE ? 8 : -8;
+
+  // Clear previous ep hash.
+  if (ep_square != SQ_NONE) {
+    hash ^= zobrist::ep_file[file_of(ep_square)];
+    ep_square = SQ_NONE;
+  }
+
+  halfmove++;
+
+  switch (move_kind(m)) {
+    case MK_CASTLE: {
+      Square kfrom = move_from(m), rfrom = move_to(m);
+      bool kingside = rfrom > kfrom;
+      Square kto = make_square(kingside ? 6 : 2, rank_of(kfrom));
+      Square rto = make_square(kingside ? 5 : 3, rank_of(kfrom));
+      remove_piece(kfrom);
+      remove_piece(rfrom);
+      put_piece(kto, make_piece(us, KING));
+      put_piece(rto, make_piece(us, ROOK));
+      // Drop all castling rights of us (their rooks live on our home rank).
+      Bitboard stale = castling_rooks & (us == WHITE ? RANK_1_BB : rank_bb(7));
+      while (stale) {
+        Square s = pop_lsb(stale);
+        castling_rooks &= ~bb(s);
+        hash ^= zobrist::castling_rook[s];
+      }
+      break;
+    }
+    case MK_DROP: {
+      Square to = move_to(m);
+      PieceType pt = move_drop_piece(m);
+      hash ^= zobrist::hand_piece[us][pt][hand[us][pt]];
+      hand[us][pt]--;
+      if (hand[us][pt]) hash ^= zobrist::hand_piece[us][pt][hand[us][pt]];
+      put_piece(to, make_piece(us, pt));
+      if (pt == PAWN) halfmove = 0;
+      break;
+    }
+    default: {
+      Square from = move_from(m), to = move_to(m);
+      int moving = board[from];
+      PieceType mpt = piece_type(moving);
+
+      if (move_kind(m) == MK_EN_PASSANT) {
+        remove_piece(to - up);  // the double-pushed enemy pawn
+        halfmove = 0;
+      } else if (!empty(to)) {
+        // Capture: clear rights if a castling rook is taken; pocket it in
+        // crazyhouse.
+        if (castling_rooks & bb(to)) {
+          castling_rooks &= ~bb(to);
+          hash ^= zobrist::castling_rook[to];
+        }
+        if (variant == VR_CRAZYHOUSE) {
+          PieceType cap = piece_type(board[to]);
+          if (hand[us][cap]) hash ^= zobrist::hand_piece[us][cap][hand[us][cap]];
+          hand[us][cap]++;
+          hash ^= zobrist::hand_piece[us][cap][hand[us][cap]];
+        }
+        remove_piece(to);
+        halfmove = 0;
+      }
+
+      remove_piece(from);
+      if (move_promo(m) != NO_PIECE_TYPE)
+        put_piece(to, make_piece(us, move_promo(m)));
+      else
+        put_piece(to, moving);
+
+      if (mpt == PAWN) {
+        halfmove = 0;
+        if (to - from == 2 * up) {
+          // Tentatively set ep; keep only if a legal capture exists.
+          ep_square = from + up;
+        }
+      } else if (mpt == KING) {
+        Bitboard stale = castling_rooks & by_color[us] &
+                         (us == WHITE ? RANK_1_BB : rank_bb(7));
+        while (stale) {
+          Square s = pop_lsb(stale);
+          castling_rooks &= ~bb(s);
+          hash ^= zobrist::castling_rook[s];
+        }
+      }
+      if (castling_rooks & bb(from)) {
+        castling_rooks &= ~bb(from);
+        hash ^= zobrist::castling_rook[from];
+      }
+      break;
+    }
+  }
+
+  if (us == BLACK) fullmove++;
+  stm = them;
+  hash ^= zobrist::black_to_move;
+
+  if (ep_square != SQ_NONE) {
+    if (ep_capture_legal())
+      hash ^= zobrist::ep_file[file_of(ep_square)];
+    else
+      ep_square = SQ_NONE;
+  }
+
+  if (variant == VR_THREE_CHECK && in_check()) {
+    // Zero count is the identity (compute_hash skips it).
+    if (checks_given[us]) hash ^= zobrist::checks[us][checks_given[us] & 3];
+    checks_given[us]++;
+    hash ^= zobrist::checks[us][checks_given[us] & 3];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// UCI
+// ---------------------------------------------------------------------------
+
+static const char PROMO_CHARS[] = {'\0', 'n', 'b', 'r', 'q', 'k'};
+
+std::string Position::uci(Move m) const {
+  if (move_kind(m) == MK_DROP) {
+    std::string out;
+    out += "PNBRQK"[move_drop_piece(m)];
+    out += '@';
+    out += square_name(move_to(m));
+    return out;
+  }
+  std::string out = square_name(move_from(m)) + square_name(move_to(m));
+  if (move_promo(m) != NO_PIECE_TYPE) out += PROMO_CHARS[move_promo(m)];
+  return out;
+}
+
+Move Position::parse_uci(const std::string& str) const {
+  MoveList legal;
+  legal_moves(legal);
+  for (Move m : legal)
+    if (uci(m) == str) return m;
+  // Standard castling notation (e1g1 / e1c1): king moves to its castling
+  // destination file instead of onto the rook.
+  for (Move m : legal) {
+    if (move_kind(m) != MK_CASTLE) continue;
+    Square kfrom = move_from(m), rfrom = move_to(m);
+    Square kto = make_square(rfrom > kfrom ? 6 : 2, rank_of(kfrom));
+    if (square_name(kfrom) + square_name(kto) == str) return m;
+  }
+  return MOVE_NONE;
+}
+
+// ---------------------------------------------------------------------------
+// Outcome
+// ---------------------------------------------------------------------------
+
+int Position::outcome() const {
+  MoveList legal;
+  legal_moves(legal);
+
+  if (variant == VR_THREE_CHECK && checks_given[~stm] >= 3) return 3;
+  if (variant == VR_KING_OF_THE_HILL) {
+    Bitboard center = bb(make_square(3, 3)) | bb(make_square(4, 3)) |
+                      bb(make_square(3, 4)) | bb(make_square(4, 4));
+    if (pieces(~stm, KING) & center) return 3;
+  }
+  if (variant == VR_RACING_KINGS) {
+    bool they_reached = pieces(~stm, KING) & rank_bb(7);
+    if (they_reached) {
+      // Black gets one extra move to equalize; simplified: if our king can
+      // also reach rank 8 it's a draw — full rule handled at game level.
+      bool we_reached = pieces(stm, KING) & rank_bb(7);
+      return we_reached ? 5 : 3;
+    }
+  }
+  if (variant == VR_HORDE && !pieces(WHITE)) return stm == WHITE ? 3 : 4;
+  if (variant == VR_ATOMIC) {
+    if (!pieces(stm, KING)) return 3;
+    if (!pieces(~stm, KING)) return 4;
+  }
+
+  if (legal.size == 0) {
+    if (variant == VR_ANTICHESS) return 4;  // no moves = win in antichess
+    if (in_check()) return 1;               // checkmate
+    if (variant == VR_HORDE && stm == WHITE && !pieces(WHITE)) return 3;
+    return 2;  // stalemate
+  }
+
+  if (variant == VR_ANTICHESS && !pieces(stm)) return 4;
+
+  if (halfmove >= 150) return 5;  // 75-move rule (automatic)
+
+  // Insufficient material (standard chess only; conservative).
+  if (variant == VR_STANDARD) {
+    Bitboard heavy = by_type[PAWN] | by_type[ROOK] | by_type[QUEEN];
+    if (!heavy) {
+      int minors = popcount(by_type[KNIGHT] | by_type[BISHOP]);
+      if (minors <= 1) return 5;
+      if (!by_type[KNIGHT]) {
+        // Bishops only: draw if all on the same color complex.
+        constexpr Bitboard DARK = 0xAA55AA55AA55AA55ULL;
+        Bitboard b = by_type[BISHOP];
+        if (!(b & DARK) || !(b & ~DARK)) return 5;
+      }
+    }
+  }
+
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Perft
+// ---------------------------------------------------------------------------
+
+uint64_t perft(const Position& pos, int depth) {
+  if (depth <= 0) return 1;
+  MoveList legal;
+  pos.legal_moves(legal);
+  if (depth == 1) return legal.size;
+  uint64_t nodes = 0;
+  for (Move m : legal) {
+    Position copy = pos;
+    copy.make(m);
+    nodes += perft(copy, depth - 1);
+  }
+  return nodes;
+}
+
+}  // namespace fc

@@ -1,0 +1,107 @@
+// Board state, FEN, move generation, make-move.
+//
+// Replaces the rules functionality the reference gets from shakmaty
+// (legality replay, src/queue.rs:543-552) and from the engines' own
+// movegen. Chess960 is handled natively: castling rights are stored as
+// rook squares and castling moves are encoded king-from -> rook-from,
+// matching UCI_Chess960 notation (the reference always enables
+// UCI_Chess960, src/stockfish.rs:212-214).
+//
+// Search uses copy-make: Position is a flat value type (~200 bytes),
+// make() mutates in place, callers copy first.
+
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "bitboard.h"
+#include "types.h"
+
+namespace fc {
+
+void init_zobrist();
+
+constexpr int MAX_MOVES = 256;
+
+struct MoveList {
+  Move moves[MAX_MOVES];
+  int size = 0;
+  void push(Move m) {
+    if (size < MAX_MOVES) moves[size++] = m;
+  }
+  const Move* begin() const { return moves; }
+  const Move* end() const { return moves + size; }
+};
+
+struct Position {
+  Bitboard by_color[COLOR_NB] = {0, 0};
+  Bitboard by_type[PIECE_TYPE_NB] = {0, 0, 0, 0, 0, 0};
+  uint8_t board[64];
+  Color stm = WHITE;
+  Bitboard castling_rooks = 0;  // rook squares that still have rights
+  Square ep_square = SQ_NONE;   // only set when an en-passant capture is legal
+  int halfmove = 0;
+  int fullmove = 1;
+  uint64_t hash = 0;
+  VariantRules variant = VR_STANDARD;
+  uint8_t checks_given[COLOR_NB] = {0, 0};      // three-check
+  uint8_t hand[COLOR_NB][PIECE_TYPE_NB] = {};   // crazyhouse pockets
+
+  // -- accessors --------------------------------------------------------
+  Bitboard occupied() const { return by_color[WHITE] | by_color[BLACK]; }
+  Bitboard pieces(Color c) const { return by_color[c]; }
+  Bitboard pieces(PieceType pt) const { return by_type[pt]; }
+  Bitboard pieces(Color c, PieceType pt) const { return by_color[c] & by_type[pt]; }
+  int piece_on(Square s) const { return board[s]; }
+  bool empty(Square s) const { return board[s] == NO_PIECE; }
+  Square king_sq(Color c) const {
+    Bitboard k = pieces(c, KING);
+    return k ? lsb(k) : SQ_NONE;
+  }
+
+  // All attackers (both colors) of square s given occupancy occ.
+  Bitboard attackers_to(Square s, Bitboard occ) const;
+  bool attacked_by(Square s, Color by, Bitboard occ) const {
+    return attackers_to(s, occ) & by_color[by];
+  }
+  Bitboard checkers() const {
+    Square k = king_sq(stm);
+    return k == SQ_NONE ? 0 : attackers_to(k, occupied()) & by_color[~stm];
+  }
+  bool in_check() const { return checkers() != 0; }
+
+  // -- setup ------------------------------------------------------------
+  // Returns empty string on success, error message otherwise.
+  std::string set_fen(const std::string& fen, VariantRules variant);
+  std::string fen() const;
+
+  // -- moves ------------------------------------------------------------
+  void gen_pseudo(MoveList& out) const;
+  bool is_legal(Move m) const;  // pseudo-legal -> fully legal
+  void legal_moves(MoveList& out) const;
+  void make(Move m);
+
+  std::string uci(Move m) const;
+  // Parse a UCI move against this position. Accepts both Chess960
+  // (king-takes-rook, e1h1) and standard (e1g1) castling notation, like
+  // shakmaty's Uci::to_move does for the reference. MOVE_NONE if illegal.
+  Move parse_uci(const std::string& str) const;
+
+  // 0 = ongoing, 1 = checkmate (stm is mated), 2 = stalemate,
+  // 3 = variant loss for stm, 4 = variant win for stm, 5 = draw.
+  int outcome() const;
+
+  uint64_t compute_hash() const;
+
+ private:
+  void put_piece(Square s, int pc);
+  void remove_piece(Square s);
+  void gen_castling(MoveList& out) const;
+  bool castle_path_ok(Square kfrom, Square rfrom) const;
+  bool ep_capture_legal() const;  // any fully legal ep capture exists?
+};
+
+uint64_t perft(const Position& pos, int depth);
+
+}  // namespace fc

@@ -1,0 +1,112 @@
+// Core types for the fishnet-tpu native chess engine.
+//
+// The reference framework (fishnet) delegates all chess rules to external
+// C++ engines (Stockfish / Fairy-Stockfish submodules) and to the shakmaty
+// Rust library for legality replay (reference: src/queue.rs:524-552).
+// This core replaces both: one native rules+search library used for batch
+// validation (via ctypes) and for the TPU-batched search engine.
+//
+// Conventions: square 0 = a1, 7 = h1, 56 = a8, 63 = h8 (little-endian
+// rank-file). White moves "up" (+8).
+
+#pragma once
+
+#include <cstdint>
+#include <cstdlib>
+#include <string>
+
+namespace fc {
+
+using Bitboard = uint64_t;
+
+enum Color : int { WHITE = 0, BLACK = 1, COLOR_NB = 2 };
+
+constexpr Color operator~(Color c) { return Color(c ^ 1); }
+
+enum PieceType : int {
+  PAWN = 0,
+  KNIGHT = 1,
+  BISHOP = 2,
+  ROOK = 3,
+  QUEEN = 4,
+  KING = 5,
+  PIECE_TYPE_NB = 6,
+  NO_PIECE_TYPE = 7,
+};
+
+// Piece = color * 6 + type; 14 = empty.
+enum Piece : int { NO_PIECE = 14 };
+
+constexpr int make_piece(Color c, PieceType pt) { return int(c) * 6 + int(pt); }
+constexpr Color piece_color(int pc) { return Color(pc / 6); }
+constexpr PieceType piece_type(int pc) { return PieceType(pc % 6); }
+
+using Square = int;
+constexpr Square SQ_NONE = -1;
+
+constexpr int file_of(Square s) { return s & 7; }
+constexpr int rank_of(Square s) { return s >> 3; }
+constexpr Square make_square(int file, int rank) { return rank * 8 + file; }
+
+constexpr Bitboard bb(Square s) { return 1ULL << s; }
+
+constexpr Bitboard FILE_A_BB = 0x0101010101010101ULL;
+constexpr Bitboard RANK_1_BB = 0xFFULL;
+constexpr Bitboard file_bb(int f) { return FILE_A_BB << f; }
+constexpr Bitboard rank_bb(int r) { return RANK_1_BB << (8 * r); }
+
+inline int popcount(Bitboard b) { return __builtin_popcountll(b); }
+inline Square lsb(Bitboard b) { return __builtin_ctzll(b); }
+inline Square msb(Bitboard b) { return 63 - __builtin_clzll(b); }
+inline Square pop_lsb(Bitboard& b) {
+  Square s = lsb(b);
+  b &= b - 1;
+  return s;
+}
+
+// ---------------------------------------------------------------------------
+// Moves. 32-bit encoding: from[0:6] to[6:12] promo[12:15] kind[15:18]
+// drop-piece[18:21]. Castling is encoded king-from -> rook-from (works
+// uniformly for standard and Chess960, like UCI_Chess960 notation).
+// ---------------------------------------------------------------------------
+
+enum MoveKind : int {
+  MK_NORMAL = 0,
+  MK_CASTLE = 1,
+  MK_EN_PASSANT = 2,
+  MK_DROP = 3,  // crazyhouse
+};
+
+using Move = uint32_t;
+constexpr Move MOVE_NONE = 0xFFFFFFFFu;
+
+constexpr Move make_move(Square from, Square to, MoveKind kind = MK_NORMAL,
+                         PieceType promo = NO_PIECE_TYPE) {
+  return Move(from) | (Move(to) << 6) | (Move(promo) << 12) | (Move(kind) << 15);
+}
+constexpr Move make_drop(Square to, PieceType pt) {
+  return Move(to) << 6 | (Move(NO_PIECE_TYPE) << 12) | (Move(MK_DROP) << 15) |
+         (Move(pt) << 18);
+}
+
+constexpr Square move_from(Move m) { return Square(m & 0x3F); }
+constexpr Square move_to(Move m) { return Square((m >> 6) & 0x3F); }
+constexpr PieceType move_promo(Move m) { return PieceType((m >> 12) & 0x7); }
+constexpr MoveKind move_kind(Move m) { return MoveKind((m >> 15) & 0x7); }
+constexpr PieceType move_drop_piece(Move m) { return PieceType((m >> 18) & 0x7); }
+
+// Variants supported by the rules layer. Mirrors the protocol's variant set
+// (reference: src/logger.rs:192-203). STANDARD covers Chess960 via
+// rook-square castling rights.
+enum VariantRules : int {
+  VR_STANDARD = 0,
+  VR_ANTICHESS = 1,
+  VR_ATOMIC = 2,
+  VR_CRAZYHOUSE = 3,
+  VR_HORDE = 4,
+  VR_KING_OF_THE_HILL = 5,
+  VR_RACING_KINGS = 6,
+  VR_THREE_CHECK = 7,
+};
+
+}  // namespace fc

@@ -1,0 +1,96 @@
+"""ctypes binding to the native chess core (cpp/libfishnetcore.so).
+
+The reference delegates chess rules to the shakmaty library
+(src/queue.rs:524-552); here the same single implementation of the rules
+serves both the Python scheduler (legality replay, batch expansion) and
+the native search engine — no duplicated rules logic.
+
+The library is built with ``make -C cpp``. This module locates it next to
+the repo's ``cpp/`` directory and (re)builds it on demand if missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_CPP_DIR = Path(__file__).resolve().parent.parent.parent / "cpp"
+_LIB_PATH = _CPP_DIR / "libfishnetcore.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+
+
+class NativeCoreError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    try:
+        subprocess.run(
+            ["make", "-C", str(_CPP_DIR), "libfishnetcore.so"],
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.CalledProcessError as err:
+        raise NativeCoreError(
+            f"failed to build native core: {err.stderr[-2000:]}"
+        ) from err
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if necessary) the native core library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not _LIB_PATH.exists():
+            _build()
+        lib = ctypes.CDLL(str(_LIB_PATH))
+
+        lib.fc_init.restype = ctypes.c_int
+        lib.fc_variant_supported.argtypes = [ctypes.c_int]
+        lib.fc_variant_supported.restype = ctypes.c_int
+        lib.fc_pos_new.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.fc_pos_new.restype = ctypes.c_void_p
+        lib.fc_pos_clone.argtypes = [ctypes.c_void_p]
+        lib.fc_pos_clone.restype = ctypes.c_void_p
+        lib.fc_pos_free.argtypes = [ctypes.c_void_p]
+        lib.fc_pos_play_uci.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.fc_pos_play_uci.restype = ctypes.c_int
+        lib.fc_pos_fen.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.fc_pos_fen.restype = ctypes.c_int
+        lib.fc_pos_turn.argtypes = [ctypes.c_void_p]
+        lib.fc_pos_turn.restype = ctypes.c_int
+        lib.fc_pos_is_check.argtypes = [ctypes.c_void_p]
+        lib.fc_pos_is_check.restype = ctypes.c_int
+        lib.fc_pos_halfmove.argtypes = [ctypes.c_void_p]
+        lib.fc_pos_halfmove.restype = ctypes.c_int
+        lib.fc_pos_fullmove.argtypes = [ctypes.c_void_p]
+        lib.fc_pos_fullmove.restype = ctypes.c_int
+        lib.fc_pos_hash.argtypes = [ctypes.c_void_p]
+        lib.fc_pos_hash.restype = ctypes.c_uint64
+        lib.fc_pos_outcome.argtypes = [ctypes.c_void_p]
+        lib.fc_pos_outcome.restype = ctypes.c_int
+        lib.fc_pos_legal_moves.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.fc_pos_legal_moves.restype = ctypes.c_int
+        lib.fc_perft.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.fc_perft.restype = ctypes.c_uint64
+
+        lib.fc_init()
+        _lib = lib
+        return lib

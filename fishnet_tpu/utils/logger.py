@@ -22,6 +22,7 @@ SHORT_VARIANT_NAMES = {
     "kingofthehill": "koth",
     "racingkings": "race",
     "threecheck": "3check",
+    "3check": "3check",
 }
 
 

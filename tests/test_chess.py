@@ -1,0 +1,181 @@
+"""Chess rules tests against the native core: perft vectors, FEN
+round-trips, castling notations, en-passant legality normalization."""
+
+import pytest
+
+from fishnet_tpu.chess import (
+    Board,
+    IllegalMoveError,
+    InvalidFenError,
+    STARTPOS_FEN,
+    UnsupportedVariantError,
+)
+from fishnet_tpu.protocol.types import Variant
+
+KIWIPETE = "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1"
+
+
+def test_startpos():
+    b = Board()
+    assert b.fen() == STARTPOS_FEN
+    assert b.turn() == "w"
+    assert len(b.legal_moves()) == 20
+    assert not b.is_check()
+    assert b.outcome() == Board.ONGOING
+
+
+@pytest.mark.parametrize(
+    "fen,depth,nodes",
+    [
+        (STARTPOS_FEN, 4, 197281),
+        (KIWIPETE, 3, 97862),
+        ("8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1", 5, 674624),
+        ("r3k2r/Pppp1ppp/1b3nbN/nP6/BBP1P3/q4N2/Pp1P2PP/R2Q1RK1 w kq - 0 1", 4, 422333),
+        ("rnbq1k1r/pp1Pbppp/2p5/8/2B5/8/PPP1NnPP/RNBQK2R w KQ - 1 8", 3, 62379),
+        ("r4rk1/1pp1qppp/p1np1n2/2b1p1B1/2B1P1b1/P1NP1N2/1PP1QPPP/R4RK1 w - - 0 10", 3, 89890),
+    ],
+)
+def test_perft(fen, depth, nodes):
+    assert Board(fen).perft(depth) == nodes
+
+
+def test_play_game_and_replay():
+    b = Board()
+    for m in "e2e4 c7c5 c2c4 b8c6 g1e2 g8f6 b1c3 c6b4 g2g3 b4d3".split():
+        b.push_uci(m)
+    assert b.turn() == "w"
+    assert b.fullmove_number() == 6
+
+
+def test_illegal_move_rejected():
+    b = Board()
+    with pytest.raises(IllegalMoveError):
+        b.push_uci("e2e5")
+    with pytest.raises(IllegalMoveError):
+        b.push_uci("e7e5")  # black's move, white to play
+    with pytest.raises(IllegalMoveError):
+        b.push_uci("junk")
+
+
+def test_castling_both_notations():
+    fen = "r3k2r/8/8/8/8/8/8/R3K2R w KQkq - 0 1"
+    # Chess960-style: king takes own rook.
+    b = Board(fen)
+    b.push_uci("e1h1")
+    assert "K" not in b.fen().split()[2]
+    # Standard style also accepted on parse.
+    b2 = Board(fen)
+    b2.push_uci("e1g1")
+    assert b.fen() == b2.fen()
+    # Queenside.
+    b3 = Board(fen)
+    b3.push_uci("e1c1")
+    b4 = Board(fen)
+    b4.push_uci("e1a1")
+    assert b3.fen() == b4.fen()
+
+
+def test_castling_through_check_illegal():
+    fen = "r3k2r/8/8/8/8/5r2/8/R3K2R w KQkq - 0 1"  # f3 rook covers f1
+    b = Board(fen)
+    moves = b.legal_moves()
+    assert "e1h1" not in moves and "e1g1" not in moves
+    assert "e1a1" in moves  # queenside still fine (b1/c1/d1 not covered)
+
+
+def test_chess960_castling():
+    # King b1, rook a1 and h1 (DFRC-style rights via file letters).
+    fen = "1k5r/8/8/8/8/8/8/RK5R w HAh - 0 1"
+    b = Board(fen)
+    moves = b.legal_moves()
+    assert "b1a1" in moves  # queenside: king onto rook square
+    assert "b1h1" in moves
+
+
+def test_chess960_rook_shelter_castle_illegal():
+    # The castling rook on b1 shields the king's destination c1 from the
+    # enemy rook on a1; once the rook moves to d1 the king would be in
+    # check, so the castle must be illegal.
+    b = Board("4k3/8/8/8/8/8/8/rR2K3 w B - 0 1")
+    assert "e1b1" not in b.legal_moves()
+    assert "e1c1" not in b.legal_moves()
+
+
+def test_en_passant_only_when_legal():
+    # After a double push creating a legal ep capture, the ep square shows.
+    b = Board()
+    b.push_uci("e2e4")
+    b.push_uci("a7a6")
+    b.push_uci("e4e5")
+    b.push_uci("d7d5")
+    assert " d6 " in b.fen()
+    assert "e5d6" in b.legal_moves()
+    # Double push with no adjacent enemy pawn: ep square normalized away.
+    b2 = Board()
+    b2.push_uci("e2e4")
+    assert " - " in b2.fen()
+
+
+def test_ep_pin_not_legal():
+    # Capturing ep would expose the king to the rook: ep square omitted.
+    fen = "8/8/8/KP5r/5p1k/8/4P3/8 b - - 0 1"
+    b = Board(fen)
+    b.push_uci("h4g5")  # reposition black king off the pin line first
+    # now from white's perspective play e2e4 and check black can take ep
+    b.push_uci("e2e4")
+    assert "f4e3" in b.legal_moves()
+
+
+def test_checkmate_and_stalemate():
+    mate = Board("rnb1kbnr/pppp1ppp/8/4p3/6Pq/5P2/PPPPP2P/RNBQKBNR w KQkq - 1 3")
+    assert mate.outcome() == Board.CHECKMATE
+    assert mate.legal_moves() == []
+    assert mate.is_check()
+    stalemate = Board("7k/5Q2/6K1/8/8/8/8/8 b - - 0 1")
+    assert stalemate.outcome() == Board.STALEMATE
+    assert not stalemate.is_check()
+
+
+def test_insufficient_material_draw():
+    assert Board("8/8/4k3/8/8/3K4/8/8 w - - 0 1").outcome() == Board.DRAW
+    assert Board("8/8/4k3/8/8/3KN3/8/8 w - - 0 1").outcome() == Board.DRAW
+    assert Board("8/8/4k3/8/8/3K4/8/Q7 w - - 0 1").outcome() == Board.ONGOING
+
+
+def test_promotion():
+    b = Board("8/P6k/8/8/8/8/8/K7 w - - 0 1")
+    b.push_uci("a7a8q")
+    assert b.fen().startswith("Q7/7k")
+
+
+def test_invalid_fen():
+    with pytest.raises(InvalidFenError):
+        Board("not a fen")
+    with pytest.raises(InvalidFenError):
+        Board("rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBN w KQkq - 0 1")
+
+
+def test_unsupported_variant_gated():
+    with pytest.raises(UnsupportedVariantError):
+        Board(variant=Variant.ATOMIC)
+
+
+def test_zobrist_transposition():
+    a = Board()
+    for m in "g1f3 g8f6 b1c3 b8c6".split():
+        a.push_uci(m)
+    b = Board()
+    for m in "b1c3 b8c6 g1f3 g8f6".split():
+        b.push_uci(m)
+    assert a.zobrist_hash() == b.zobrist_hash()
+    c = Board()
+    assert c.zobrist_hash() != a.zobrist_hash()
+
+
+def test_fen_roundtrip():
+    for fen in [
+        STARTPOS_FEN,
+        KIWIPETE,
+        "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
+    ]:
+        assert Board(fen).fen() == fen
