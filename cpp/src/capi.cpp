@@ -70,6 +70,16 @@ int fc_pos_fen(const Position* pos, char* buf, int len) {
   return copy_out(pos->fen(), buf, len);
 }
 
+// Parse a UCI move (accepting standard castling notation) and return its
+// canonical encoding (Chess960-style castling), without playing it. -1 if
+// illegal. Mirrors the reference's move renormalization through shakmaty
+// (src/queue.rs:543-552).
+int fc_pos_parse_uci(const Position* pos, const char* uci, char* buf, int len) {
+  Move m = pos->parse_uci(uci ? uci : "");
+  if (m == MOVE_NONE) return -1;
+  return copy_out(pos->uci(m), buf, len);
+}
+
 int fc_pos_turn(const Position* pos) { return int(pos->stm); }
 
 int fc_pos_is_check(const Position* pos) { return pos->in_check() ? 1 : 0; }

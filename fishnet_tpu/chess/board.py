@@ -94,6 +94,14 @@ class Board:
         if self._lib.fc_pos_play_uci(self._pos, uci.encode()) != 0:
             raise IllegalMoveError(f"illegal move {uci!r} in {self.fen()}")
 
+    def normalize_uci(self, uci: str) -> Optional[str]:
+        """Canonical UCI of a legal move (standard castling notation is
+        rewritten to king-takes-rook); None if the move is illegal."""
+        buf = ctypes.create_string_buffer(16)
+        if self._lib.fc_pos_parse_uci(self._pos, uci.encode(), buf, len(buf)) < 0:
+            return None
+        return buf.value.decode()
+
     def fen(self) -> str:
         buf = ctypes.create_string_buffer(_BUF_LEN)
         if self._lib.fc_pos_fen(self._pos, buf, _BUF_LEN) < 0:

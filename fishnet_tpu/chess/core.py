@@ -30,6 +30,9 @@ class NativeCoreError(RuntimeError):
 
 
 def _build() -> None:
+    """(Re)build the library. `make` is a cheap no-op when up to date, so
+    this runs on every first load — a stale .so surviving C++ source
+    changes would otherwise be loaded silently."""
     try:
         subprocess.run(
             ["make", "-C", str(_CPP_DIR), "libfishnetcore.so"],
@@ -37,9 +40,12 @@ def _build() -> None:
             capture_output=True,
             text=True,
         )
-    except subprocess.CalledProcessError as err:
+    except (subprocess.CalledProcessError, OSError) as err:
+        if _LIB_PATH.exists():
+            return  # no toolchain here; fall back to the prebuilt library
+        stderr = getattr(err, "stderr", "") or str(err)
         raise NativeCoreError(
-            f"failed to build native core: {err.stderr[-2000:]}"
+            f"failed to build native core: {stderr[-2000:]}"
         ) from err
 
 
@@ -49,8 +55,7 @@ def load() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not _LIB_PATH.exists():
-            _build()
+        _build()
         lib = ctypes.CDLL(str(_LIB_PATH))
 
         lib.fc_init.restype = ctypes.c_int
@@ -82,6 +87,13 @@ def load() -> ctypes.CDLL:
         lib.fc_pos_hash.restype = ctypes.c_uint64
         lib.fc_pos_outcome.argtypes = [ctypes.c_void_p]
         lib.fc_pos_outcome.restype = ctypes.c_int
+        lib.fc_pos_parse_uci.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.fc_pos_parse_uci.restype = ctypes.c_int
         lib.fc_pos_legal_moves.argtypes = [
             ctypes.c_void_p,
             ctypes.c_char_p,

@@ -1,0 +1,204 @@
+"""Client supervisor: wires the API actor, queue actor, and worker pool.
+
+Equivalent of the reference's run()/worker() (src/main.rs:76-403):
+
+* one worker task per configured core, each owning at most one engine per
+  flavor, created lazily with randomized restart backoff
+  (main.rs:266-312);
+* per-job rolling time budget: min(60 s, remaining) + the job's timeout;
+  a hung engine is killed and the position reported failed
+  (main.rs:272-273, 316, 343-358);
+* workers request work via the Pull handshake and exit when the queue
+  cancels their callback (drain);
+* two-phase shutdown: ``shutdown_soon`` stops acquiring and drains
+  pending batches, ``shutdown`` additionally aborts them upstream
+  (main.rs:217-259).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from fishnet_tpu.engine.base import Engine, EngineError, EngineFactory
+from fishnet_tpu.ipc import Position, PositionFailed
+from fishnet_tpu.net import api as api_mod
+from fishnet_tpu.sched import queue as queue_mod
+from fishnet_tpu.sched.queue import BacklogOpt, Pull
+from fishnet_tpu.protocol.types import EngineFlavor
+from fishnet_tpu.utils.backoff import RandomizedBackoff
+from fishnet_tpu.utils.logger import Logger
+from fishnet_tpu.utils.stats import StatsRecorder
+from fishnet_tpu.version import __version__
+
+DEFAULT_BUDGET_SECONDS = 60.0  # main.rs:272
+SUMMARY_INTERVAL_SECONDS = 120.0  # main.rs:202
+
+
+async def worker(
+    i: int,
+    factory: EngineFactory,
+    queue: queue_mod.QueueStub,
+    logger: Logger,
+) -> None:
+    logger.debug(f"Started worker {i}.")
+    job: Optional[Position] = None
+    engines: Dict[EngineFlavor, Engine] = {}
+    engine_backoff = RandomizedBackoff()
+    budget = DEFAULT_BUDGET_SECONDS
+
+    try:
+        while True:
+            response: Optional[object] = None
+            if job is not None:
+                flavor = job.flavor
+                engine = engines.pop(flavor, None)
+                if engine is None:
+                    backoff = engine_backoff.next()
+                    level = logger.info if backoff >= 5.0 else logger.debug
+                    level(f"Waiting {backoff:.1f}s before attempting to start engine")
+                    await asyncio.sleep(backoff)
+                    budget = DEFAULT_BUDGET_SECONDS
+                    try:
+                        engine = await factory.create(flavor)
+                    except EngineError as err:
+                        logger.error(f"Worker {i} failed to start engine: {err}")
+                        response = PositionFailed(batch_id=job.work.id)
+                        job = None
+
+                if engine is not None:
+                    budget = min(DEFAULT_BUDGET_SECONDS, budget) + job.work.timeout_seconds()
+                    started = time.monotonic()
+                    try:
+                        response = await asyncio.wait_for(engine.go(job), timeout=budget)
+                        engines[flavor] = engine
+                        engine_backoff.reset()
+                    except asyncio.TimeoutError:
+                        logger.warn(
+                            f"Engine timed out in worker {i}. If this happens "
+                            "frequently it is better to stop and defer to "
+                            f"faster clients. Context: {job.url or job.work.id}"
+                        )
+                        await engine.close()
+                        response = PositionFailed(batch_id=job.work.id)
+                    except asyncio.CancelledError:
+                        await engine.close()
+                        raise
+                    except Exception as err:  # noqa: BLE001 - engine must not kill worker
+                        logger.warn(
+                            f"Worker {i} engine error: {err!r}. "
+                            f"Context: {job.url or job.work.id}"
+                        )
+                        await engine.close()
+                        response = PositionFailed(batch_id=job.work.id)
+                    budget = max(0.0, budget - (time.monotonic() - started))
+                    if budget < DEFAULT_BUDGET_SECONDS:
+                        logger.debug(f"Low engine timeout budget: {budget:.1f}s")
+                    job = None
+
+            callback = asyncio.get_running_loop().create_future()
+            await queue.pull(Pull(response=response, callback=callback))
+            try:
+                job = await callback
+            except asyncio.CancelledError:
+                break
+    finally:
+        for engine in engines.values():
+            await engine.close()
+        logger.debug(f"Stopped worker {i}")
+
+
+@dataclass
+class Client:
+    """A running fishnet-tpu client instance."""
+
+    endpoint: str
+    key: Optional[str]
+    cores: int
+    engine_factory: EngineFactory
+    logger: Logger = field(default_factory=Logger)
+    stats: Optional[StatsRecorder] = None
+    backlog: Optional[BacklogOpt] = None
+    max_backoff: float = 30.0
+
+    _tasks: List[asyncio.Task] = field(default_factory=list)
+    _queue_stub: Optional[queue_mod.QueueStub] = None
+    _api_actor: Optional[api_mod.ApiActor] = None
+    _api_stub: Optional[api_mod.ApiStub] = None
+
+    async def start(self) -> None:
+        api_stub, api_actor = api_mod.channel(self.endpoint, self.key, self.logger)
+        self._api_stub = api_stub
+        self._api_actor = api_actor
+        self._tasks.append(asyncio.create_task(api_actor.run(), name="api"))
+
+        queue_stub, queue_actor = queue_mod.channel(
+            cores=self.cores,
+            api=api_stub,
+            logger=self.logger,
+            stats=self.stats,
+            backlog=self.backlog,
+            max_backoff=self.max_backoff,
+        )
+        self._queue_stub = queue_stub
+        self._tasks.append(asyncio.create_task(queue_actor.run(), name="queue"))
+
+        for i in range(self.cores):
+            self._tasks.append(
+                asyncio.create_task(
+                    worker(i, self.engine_factory, queue_stub, self.logger),
+                    name=f"worker-{i}",
+                )
+            )
+
+    def stats_summary(self) -> str:
+        assert self._queue_stub is not None
+        stats, nnue_nps = self._queue_stub.stats()
+        return (
+            f"fishnet-tpu/{__version__}: {nnue_nps} (nnue), "
+            f"{stats.total_batches:,} batches, {stats.total_positions:,} positions, "
+            f"{stats.total_nodes:,} total nodes"
+        )
+
+    async def run_summary_loop(self) -> None:
+        """Periodic 120 s summary line (main.rs:201-213)."""
+        while True:
+            await asyncio.sleep(SUMMARY_INTERVAL_SECONDS)
+            self.logger.fishnet_info(self.stats_summary())
+
+    def shutdown_soon(self) -> None:
+        """First Ctrl-C: stop acquiring, finish pending batches."""
+        if self._queue_stub is not None:
+            self._queue_stub.shutdown_soon()
+
+    async def stop(self, abort_pending: bool = True) -> None:
+        """Graceful stop. With ``abort_pending`` the server is told to
+        reassign unfinished batches immediately (main.rs:248-249)."""
+        if self._queue_stub is not None:
+            if abort_pending:
+                self._queue_stub.shutdown()
+            else:
+                self._queue_stub.shutdown_soon()
+
+        # Workers + queue drain first; the api actor must outlive them to
+        # deliver final submissions/aborts.
+        worker_and_queue = [
+            t for t in self._tasks if t.get_name() != "api" and not t.done()
+        ]
+        if worker_and_queue:
+            await asyncio.wait(worker_and_queue, timeout=30.0)
+            for t in worker_and_queue:
+                if not t.done():
+                    t.cancel()
+
+        if self._api_actor is not None:
+            self._api_actor.stop()
+        api_tasks = [t for t in self._tasks if t.get_name() == "api" and not t.done()]
+        if api_tasks:
+            await asyncio.wait(api_tasks, timeout=10.0)
+            for t in api_tasks:
+                if not t.done():
+                    t.cancel()
+        self._tasks.clear()

@@ -1,0 +1,317 @@
+"""HTTP communication backend: the only server-facing I/O in the client.
+
+Behavioral equivalent of the reference's ApiActor/ApiStub pair
+(src/api.rs:28-767): all server traffic is serialized through one actor
+task so that error backoff applies globally; requests carry bearer-key
+auth plus the legacy ``fishnet.apikey`` body field; 429 responses suspend
+all traffic for 60 s + jittered backoff; 400/401/403/406 on acquire mean
+the server rejected this client and the queue must stop
+(doc/protocol.md:240-244).
+
+Implemented on asyncio + aiohttp. The future-based message passing
+mirrors the reference's mpsc/oneshot channels.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import aiohttp
+
+from fishnet_tpu.protocol.types import (
+    Acquired,
+    AcquireResponseBody,
+    AnalysisPartJson,
+    AnalysisStatus,
+    EvalFlavor,
+    ProtocolError,
+    analysis_request_body,
+    move_request_body,
+    void_request_body,
+)
+from fishnet_tpu.utils.backoff import RandomizedBackoff
+from fishnet_tpu.utils.logger import Logger
+from fishnet_tpu.version import PROTOCOL_VERSION, user_agent
+
+REQUEST_TIMEOUT_SECONDS = 30.0  # api.rs:527
+POOL_IDLE_TIMEOUT_SECONDS = 25.0  # api.rs:528
+
+
+class KeyError_(Exception):
+    """Key rejected by the server (access denied)."""
+
+
+@dataclass
+class _Message:
+    kind: str
+    future: Optional[asyncio.Future] = None
+    batch_id: Optional[str] = None
+    flavor: Optional[EvalFlavor] = None
+    analysis: Optional[List[Optional[AnalysisPartJson]]] = None
+    best_move: Optional[str] = None
+    slow: bool = False
+
+
+@dataclass
+class ApiStub:
+    """Cheap cloneable handle enqueueing messages to the actor."""
+
+    _queue: "asyncio.Queue[_Message]"
+    endpoint: str
+
+    async def check_key(self) -> Optional[Exception]:
+        """None if the key is accepted; the error otherwise."""
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put(_Message("check_key", future=fut))
+        try:
+            await fut
+            return None
+        except Exception as err:  # noqa: BLE001 - propagate to caller as value
+            return err
+
+    async def status(self) -> Optional[AnalysisStatus]:
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put(_Message("status", future=fut))
+        try:
+            return await fut
+        except Exception:  # noqa: BLE001
+            return None
+
+    def abort(self, batch_id: str) -> None:
+        self._queue.put_nowait(_Message("abort", batch_id=batch_id))
+
+    async def acquire(self, slow: bool) -> Optional[Acquired]:
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put(_Message("acquire", future=fut, slow=slow))
+        try:
+            return await fut
+        except Exception:  # noqa: BLE001
+            return None
+
+    def submit_analysis(
+        self,
+        batch_id: str,
+        flavor: EvalFlavor,
+        analysis: List[Optional[AnalysisPartJson]],
+    ) -> None:
+        self._queue.put_nowait(
+            _Message("submit_analysis", batch_id=batch_id, flavor=flavor, analysis=analysis)
+        )
+
+    async def submit_move_and_acquire(
+        self, batch_id: str, best_move: Optional[str]
+    ) -> Optional[Acquired]:
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put(
+            _Message("submit_move", future=fut, batch_id=batch_id, best_move=best_move)
+        )
+        try:
+            return await fut
+        except Exception:  # noqa: BLE001
+            return None
+
+
+class ApiActor:
+    def __init__(
+        self,
+        queue: "asyncio.Queue[_Message]",
+        endpoint: str,
+        key: Optional[str],
+        logger: Logger,
+    ) -> None:
+        self.queue = queue
+        self.endpoint = endpoint.rstrip("/")
+        self.key = key
+        self.logger = logger
+        self.error_backoff = RandomizedBackoff()
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._stopped = False
+
+    def _make_session(self) -> aiohttp.ClientSession:
+        headers = {"User-Agent": user_agent()}
+        if self.key:
+            headers["Authorization"] = f"Bearer {self.key}"
+        return aiohttp.ClientSession(
+            headers=headers,
+            timeout=aiohttp.ClientTimeout(total=REQUEST_TIMEOUT_SECONDS),
+            connector=aiohttp.TCPConnector(keepalive_timeout=POOL_IDLE_TIMEOUT_SECONDS),
+        )
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.queue.put_nowait(_Message("stop"))
+
+    async def run(self) -> None:
+        self.logger.debug("Api actor started")
+        self._session = self._make_session()
+        try:
+            while True:
+                msg = await self.queue.get()
+                if msg.kind == "stop":
+                    break
+                await self._handle(msg)
+                if self._stopped and self.queue.empty():
+                    break
+        finally:
+            await self._session.close()
+            self.logger.debug("Api actor exited")
+
+    async def _handle(self, msg: _Message) -> None:
+        try:
+            await self._handle_inner(msg)
+            self.error_backoff.reset()
+        except asyncio.CancelledError:
+            raise
+        except RateLimited:
+            backoff = 60.0 + self.error_backoff.next()
+            self.logger.error(
+                f"Too many requests. Suspending requests for {backoff:.1f}s."
+            )
+            if msg.future and not msg.future.done():
+                msg.future.set_exception(RateLimited())
+            await asyncio.sleep(backoff)
+        except Exception as err:  # noqa: BLE001 - any transport/protocol error
+            backoff = self.error_backoff.next()
+            self.logger.error(f"{err!r}. Backing off {backoff:.1f}s.")
+            if msg.future and not msg.future.done():
+                msg.future.set_exception(err)
+            await asyncio.sleep(backoff)
+
+    async def _abort(self, batch_id: str) -> None:
+        self.logger.warn(f"Aborting batch {batch_id}.")
+        async with self._session.post(
+            f"{self.endpoint}/abort/{batch_id}",
+            json=void_request_body(PROTOCOL_VERSION, self.key),
+        ) as res:
+            if res.status == 404:
+                self.logger.warn(
+                    f"Fishnet server does not support abort (404 for {batch_id})."
+                )
+                return
+            res.raise_for_status()
+
+    async def _parse_acquired(self, res: aiohttp.ClientResponse, msg: _Message) -> None:
+        """Shared 202/204/reject handling for acquire and move-submit."""
+        if res.status == 204:
+            self._fulfil(msg, Acquired.no_content())
+        elif res.status in (400, 401, 403, 406):
+            text = await res.text()
+            self.logger.error(f"Server rejected request: {text}")
+            self._fulfil(msg, Acquired.rejected())
+        elif res.status in (200, 202):
+            try:
+                body = AcquireResponseBody.from_json(await res.json())
+            except ProtocolError as err:
+                self.logger.error(f"Invalid acquire response: {err}")
+                self._fulfil(msg, Acquired.no_content())
+                return
+            if not self._fulfil(msg, Acquired.accepted(body)):
+                # Nobody is waiting for this job anymore: abort so the
+                # server can reassign immediately (api.rs:678-684).
+                self.logger.error("Acquired a batch, but callback dropped. Aborting.")
+                await self._abort(body.work.id)
+        else:
+            self.logger.warn(f"Unexpected status for acquire: {res.status}")
+            res.raise_for_status()
+
+    def _fulfil(self, msg: _Message, value: object) -> bool:
+        if msg.future is not None and not msg.future.done():
+            msg.future.set_result(value)
+            return True
+        return False
+
+    async def _handle_inner(self, msg: _Message) -> None:
+        assert self._session is not None
+        if msg.kind == "check_key":
+            async with self._session.get(f"{self.endpoint}/key") as res:
+                if res.status in (200, 204):
+                    self._fulfil(msg, None)
+                elif res.status in (401, 403):
+                    if msg.future and not msg.future.done():
+                        msg.future.set_exception(KeyError_("access denied"))
+                elif res.status == 404:
+                    await self._check_key_legacy(msg)
+                elif res.status == 429:
+                    raise RateLimited()
+                else:
+                    self.logger.warn(f"Unexpected status while checking key: {res.status}")
+                    res.raise_for_status()
+        elif msg.kind == "status":
+            async with self._session.get(f"{self.endpoint}/status") as res:
+                if res.status == 200:
+                    self._fulfil(msg, AnalysisStatus.from_json(await res.json()))
+                elif res.status == 404:
+                    # Queue monitoring not supported (e.g. lila-fishnet);
+                    # leave the future pending-free with None result.
+                    self._fulfil(msg, None)
+                elif res.status == 429:
+                    raise RateLimited()
+                else:
+                    self.logger.warn(f"Unexpected status for queue status: {res.status}")
+                    res.raise_for_status()
+        elif msg.kind == "abort":
+            await self._abort(msg.batch_id)
+        elif msg.kind == "acquire":
+            async with self._session.post(
+                f"{self.endpoint}/acquire",
+                params={"slow": "true" if msg.slow else "false"},
+                json=void_request_body(PROTOCOL_VERSION, self.key),
+            ) as res:
+                if res.status == 429:
+                    raise RateLimited()
+                await self._parse_acquired(res, msg)
+        elif msg.kind == "submit_analysis":
+            async with self._session.post(
+                f"{self.endpoint}/analysis/{msg.batch_id}",
+                params={"stop": "true", "slow": "false"},
+                json=analysis_request_body(
+                    PROTOCOL_VERSION, self.key, msg.flavor, msg.analysis
+                ),
+            ) as res:
+                if res.status == 429:
+                    raise RateLimited()
+                res.raise_for_status()
+                if res.status != 204:
+                    self.logger.warn(
+                        f"Unexpected status for submitting analysis: {res.status}"
+                    )
+        elif msg.kind == "submit_move":
+            async with self._session.post(
+                f"{self.endpoint}/move/{msg.batch_id}",
+                json=move_request_body(PROTOCOL_VERSION, self.key, msg.best_move),
+            ) as res:
+                if res.status == 429:
+                    raise RateLimited()
+                await self._parse_acquired(res, msg)
+        else:
+            raise AssertionError(f"unknown message kind {msg.kind}")
+
+    async def _check_key_legacy(self, msg: _Message) -> None:
+        self.logger.debug("Falling back to legacy key validation")
+        async with self._session.get(
+            f"{self.endpoint}/key/{self.key or ''}"
+        ) as res:
+            if res.status == 200:
+                self._fulfil(msg, None)
+            elif res.status == 404:
+                if msg.future and not msg.future.done():
+                    msg.future.set_exception(KeyError_("access denied"))
+            else:
+                self.logger.warn(
+                    f"Unexpected status while checking legacy key: {res.status}"
+                )
+                res.raise_for_status()
+
+
+class RateLimited(Exception):
+    """HTTP 429: suspend all requests (api.rs:550-556)."""
+
+
+def channel(endpoint: str, key: Optional[str], logger: Logger) -> tuple:
+    """Create a connected (ApiStub, ApiActor) pair."""
+    queue: "asyncio.Queue[_Message]" = asyncio.Queue()
+    stub = ApiStub(_queue=queue, endpoint=endpoint.rstrip("/"))
+    actor = ApiActor(queue, endpoint, key, logger)
+    return stub, actor

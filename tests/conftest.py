@@ -17,3 +17,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture
+def anyio_backend():
+    # aiohttp requires asyncio; never run async tests on trio.
+    return "asyncio"

@@ -1,0 +1,231 @@
+"""A fake lichess fishnet server for integration tests.
+
+Serves the JSON protocol documented in the reference's doc/protocol.md
+(acquire / analysis / move / abort / status / key). The reference has no
+such test double — SURVEY.md §4 calls out creating one as the first piece
+of test infrastructure the new framework must add.
+
+Queue semantics mimic lila: jobs are handed out on acquire, re-queued if
+aborted, and recorded on submission. ``slow=true`` clients only get
+system-queue jobs.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from aiohttp import web
+
+VALID_KEY = "TESTKEY"
+
+
+@dataclass
+class FakeJob:
+    body: dict
+    user_queue: bool = True
+    acquired_by: Optional[str] = None
+
+
+@dataclass
+class FakeLichess:
+    """In-memory job queue + recorders, exposed over HTTP."""
+
+    jobs: List[FakeJob] = field(default_factory=list)
+    analyses: Dict[str, List[dict]] = field(default_factory=dict)
+    progress_reports: Dict[str, List[dict]] = field(default_factory=dict)
+    moves: Dict[str, dict] = field(default_factory=dict)
+    aborted: List[str] = field(default_factory=list)
+    acquire_count: int = 0
+    reject_with: Optional[int] = None  # force an HTTP status on acquire
+    status_supported: bool = True
+    abort_supported: bool = True
+    require_key: bool = True
+    _counter: itertools.count = field(default_factory=itertools.count)
+
+    # -- job injection (test side) ---------------------------------------
+
+    def add_analysis_job(
+        self,
+        moves: str = "e2e4 e7e5",
+        position: str = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+        variant: str = "standard",
+        skip_positions: Optional[List[int]] = None,
+        nodes: int = 5000,
+        game_id: Optional[str] = None,
+        multipv: Optional[int] = None,
+        depth: Optional[int] = None,
+        user_queue: bool = False,
+        work_id: Optional[str] = None,
+    ) -> str:
+        work_id = work_id or f"wk{next(self._counter):06d}"
+        work = {
+            "type": "analysis",
+            "id": work_id,
+            "nodes": {"sf15": nodes, "sf14": nodes, "classical": nodes * 2},
+            "timeout": 7000,
+        }
+        if multipv is not None:
+            work["multipv"] = multipv
+        if depth is not None:
+            work["depth"] = depth
+        body = {
+            "work": work,
+            "game_id": game_id or "",
+            "position": position,
+            "variant": variant,
+            "moves": moves,
+            "skipPositions": skip_positions or [],
+        }
+        self.jobs.append(FakeJob(body=body, user_queue=user_queue))
+        return work_id
+
+    def add_move_job(
+        self,
+        moves: str = "",
+        position: str = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+        level: int = 5,
+        clock: Optional[dict] = None,
+        variant: str = "standard",
+        work_id: Optional[str] = None,
+    ) -> str:
+        work_id = work_id or f"wk{next(self._counter):06d}"
+        work: dict = {"type": "move", "id": work_id, "level": level}
+        if clock:
+            work["clock"] = clock
+        body = {
+            "work": work,
+            "game_id": "",
+            "position": position,
+            "variant": variant,
+            "moves": moves,
+        }
+        self.jobs.append(FakeJob(body=body, user_queue=False))
+        return work_id
+
+    # -- handlers --------------------------------------------------------
+
+    def _check_auth(self, request: web.Request, body: Optional[dict]) -> bool:
+        if not self.require_key:
+            return True
+        auth = request.headers.get("Authorization", "")
+        if auth == f"Bearer {VALID_KEY}":
+            return True
+        if body and body.get("fishnet", {}).get("apikey") == VALID_KEY:
+            return True
+        return False
+
+    async def handle_acquire(self, request: web.Request) -> web.Response:
+        self.acquire_count += 1
+        body = await request.json()
+        if self.reject_with:
+            return web.Response(status=self.reject_with, text="rejected by test")
+        if not self._check_auth(request, body):
+            return web.Response(status=401, text="unknown key")
+        slow = request.query.get("slow") == "true"
+        for job in self.jobs:
+            if job.acquired_by is None and not (slow and job.user_queue):
+                job.acquired_by = body.get("fishnet", {}).get("apikey", "?")
+                return web.json_response(job.body, status=202)
+        return web.Response(status=204)
+
+    async def handle_analysis(self, request: web.Request) -> web.Response:
+        work_id = request.match_info["id"]
+        body = await request.json()
+        if not self._check_auth(request, body):
+            return web.Response(status=401)
+        parts = body.get("analysis", [])
+        # Lila quirk: a report whose first part is null is a progress
+        # report, not a completed analysis (reference src/queue.rs:686-697).
+        if parts and parts[0] is None:
+            self.progress_reports.setdefault(work_id, []).append(body)
+        else:
+            self.analyses[work_id] = body
+            self.jobs = [j for j in self.jobs if j.body["work"]["id"] != work_id]
+        return web.Response(status=204)
+
+    async def handle_move(self, request: web.Request) -> web.Response:
+        work_id = request.match_info["id"]
+        body = await request.json()
+        if not self._check_auth(request, body):
+            return web.Response(status=401)
+        self.moves[work_id] = body
+        self.jobs = [j for j in self.jobs if j.body["work"]["id"] != work_id]
+        # Chained acquire (202 with next job) when available.
+        for job in self.jobs:
+            if job.acquired_by is None and job.body["work"]["type"] == "move":
+                job.acquired_by = "chained"
+                return web.json_response(job.body, status=202)
+        return web.Response(status=204)
+
+    async def handle_abort(self, request: web.Request) -> web.Response:
+        if not self.abort_supported:
+            return web.Response(status=404)
+        work_id = request.match_info["id"]
+        body = await request.json()
+        if not self._check_auth(request, body):
+            return web.Response(status=401)
+        self.aborted.append(work_id)
+        for job in self.jobs:
+            if job.body["work"]["id"] == work_id:
+                job.acquired_by = None  # re-queue
+        return web.Response(status=204)
+
+    async def handle_status(self, request: web.Request) -> web.Response:
+        if not self.status_supported:
+            return web.Response(status=404)
+        user = [j for j in self.jobs if j.user_queue and j.acquired_by is None]
+        system = [j for j in self.jobs if not j.user_queue and j.acquired_by is None]
+        return web.json_response(
+            {
+                "analysis": {
+                    "user": {"acquired": 0, "queued": len(user), "oldest": 0},
+                    "system": {"acquired": 0, "queued": len(system), "oldest": 0},
+                }
+            }
+        )
+
+    async def handle_key(self, request: web.Request) -> web.Response:
+        auth = request.headers.get("Authorization", "")
+        if auth == f"Bearer {VALID_KEY}":
+            return web.Response(status=200)
+        return web.Response(status=401)
+
+    async def handle_key_legacy(self, request: web.Request) -> web.Response:
+        if request.match_info["key"] == VALID_KEY:
+            return web.Response(status=200)
+        return web.Response(status=404)
+
+    def app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/fishnet/acquire", self.handle_acquire)
+        app.router.add_post("/fishnet/analysis/{id}", self.handle_analysis)
+        app.router.add_post("/fishnet/move/{id}", self.handle_move)
+        app.router.add_post("/fishnet/abort/{id}", self.handle_abort)
+        app.router.add_get("/fishnet/status", self.handle_status)
+        app.router.add_get("/fishnet/key", self.handle_key)
+        app.router.add_get("/fishnet/key/{key}", self.handle_key_legacy)
+        return app
+
+
+class FakeServer:
+    """Async context manager running a FakeLichess on an ephemeral port."""
+
+    def __init__(self, lichess: Optional[FakeLichess] = None) -> None:
+        self.lichess = lichess or FakeLichess()
+        self.endpoint = ""
+        self._runner: Optional[web.AppRunner] = None
+
+    async def __aenter__(self) -> "FakeServer":
+        self._runner = web.AppRunner(self.lichess.app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        self.endpoint = f"http://127.0.0.1:{port}/fishnet"
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self._runner:
+            await self._runner.cleanup()

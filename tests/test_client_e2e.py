@@ -1,0 +1,205 @@
+"""End-to-end client tests against the fake lichess server: acquire ->
+validate -> expand -> analyse (mock engine) -> reassemble -> submit."""
+
+import asyncio
+
+import pytest
+
+from fishnet_tpu.client import Client
+from fishnet_tpu.engine.mock import MockEngineFactory
+from fishnet_tpu.sched.queue import BacklogOpt
+from fishnet_tpu.utils.logger import Logger
+from tests.fake_server import VALID_KEY, FakeServer
+
+pytestmark = pytest.mark.anyio
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+def make_client(endpoint, cores=2, **kwargs) -> Client:
+    return Client(
+        endpoint=endpoint,
+        key=VALID_KEY,
+        cores=cores,
+        engine_factory=kwargs.pop("engine_factory", MockEngineFactory()),
+        logger=Logger(verbose=0),
+        max_backoff=kwargs.pop("max_backoff", 0.2),
+        **kwargs,
+    )
+
+
+async def test_analysis_batch_end_to_end():
+    async with FakeServer() as server:
+        moves = "e2e4 c7c5 c2c4 b8c6 g1e2 g8f6 b1c3 c6b4 g2g3 b4d3"
+        work_id = server.lichess.add_analysis_job(moves=moves, skip_positions=[1, 4])
+        client = make_client(server.endpoint)
+        await client.start()
+        assert await wait_for(lambda: work_id in server.lichess.analyses)
+        await client.stop()
+
+        body = server.lichess.analyses[work_id]
+        assert body["fishnet"]["apikey"] == VALID_KEY
+        assert body["stockfish"]["flavor"] == "nnue"
+        parts = body["analysis"]
+        assert len(parts) == 11  # root + 10 plies
+        assert parts[1] == {"skipped": True}
+        assert parts[4] == {"skipped": True}
+        for i, part in enumerate(parts):
+            assert part is not None
+            if i not in (1, 4):
+                assert "score" in part and "depth" in part and "nodes" in part
+
+
+async def test_move_job_end_to_end():
+    async with FakeServer() as server:
+        work_id = server.lichess.add_move_job(
+            moves="e2e4", level=5, clock={"wtime": 18000, "btime": 18000, "inc": 2}
+        )
+        client = make_client(server.endpoint, cores=1)
+        await client.start()
+        assert await wait_for(lambda: work_id in server.lichess.moves)
+        await client.stop()
+        best = server.lichess.moves[work_id]["move"]["bestmove"]
+        assert isinstance(best, str) and len(best) >= 4
+
+
+async def test_all_skipped_batch_completes_immediately():
+    async with FakeServer() as server:
+        work_id = server.lichess.add_analysis_job(
+            moves="e2e4", skip_positions=[0, 1]
+        )
+        client = make_client(server.endpoint, cores=1)
+        await client.start()
+        assert await wait_for(lambda: work_id in server.lichess.analyses)
+        await client.stop()
+        parts = server.lichess.analyses[work_id]["analysis"]
+        assert parts == [{"skipped": True}, {"skipped": True}]
+
+
+async def test_invalid_batch_ignored():
+    async with FakeServer() as server:
+        bad = server.lichess.add_analysis_job(moves="e2e4 e2e4")  # illegal replay
+        good = server.lichess.add_analysis_job(moves="d2d4")
+        client = make_client(server.endpoint, cores=1)
+        await client.start()
+        assert await wait_for(lambda: good in server.lichess.analyses)
+        await client.stop()
+        assert bad not in server.lichess.analyses
+
+
+async def test_engine_failure_abandons_batch_silently():
+    async with FakeServer() as server:
+        # Fail while analysing ply 3 of the doomed batch.
+        doomed = server.lichess.add_analysis_job(moves="e2e4 e7e5 g1f3")
+        survivor = server.lichess.add_analysis_job(moves="d2d4")
+        factory = MockEngineFactory(fail_on="#3")
+        client = make_client(server.endpoint, cores=1, engine_factory=factory)
+        await client.start()
+        assert await wait_for(lambda: survivor in server.lichess.analyses)
+        await client.stop()
+        # The doomed batch is neither submitted nor aborted: the server
+        # reassigns it by timeout (reference queue.rs:207-214).
+        assert doomed not in server.lichess.analyses
+        assert doomed not in server.lichess.aborted
+
+
+async def test_rejected_acquire_stops_queue():
+    async with FakeServer() as server:
+        server.lichess.reject_with = 406
+        client = make_client(server.endpoint, cores=1)
+        await client.start()
+        assert await wait_for(lambda: server.lichess.acquire_count >= 1)
+        # Queue stops on its own; acquire count must not keep growing.
+        await asyncio.sleep(0.3)
+        count = server.lichess.acquire_count
+        await asyncio.sleep(0.3)
+        assert server.lichess.acquire_count == count
+        await client.stop()
+
+
+async def test_shutdown_aborts_pending_batches():
+    async with FakeServer() as server:
+        work_id = server.lichess.add_analysis_job(
+            moves="e2e4 e7e5 g1f3 b8c6 f1b5 a7a6 b5a4 g8f6"
+        )
+        # Slow engine so the batch is still pending at shutdown.
+        factory = MockEngineFactory(delay_seconds=0.5)
+        client = make_client(server.endpoint, cores=1, engine_factory=factory)
+        await client.start()
+        assert await wait_for(lambda: server.lichess.acquire_count >= 1)
+        await asyncio.sleep(0.1)  # let the batch enter pending
+        await client.stop(abort_pending=True)
+        assert work_id in server.lichess.aborted
+        assert work_id not in server.lichess.analyses
+
+
+async def test_progress_reports_sent_with_null_first_part():
+    async with FakeServer() as server:
+        moves = " ".join(
+            "e2e4 e7e5 g1f3 b8c6 f1b5 a7a6 b5a4 g8f6 e1h1 f8e7 f1e1 b7b5 a4b3 d7d6".split()
+        )
+        work_id = server.lichess.add_analysis_job(moves=moves)
+        factory = MockEngineFactory(delay_seconds=0.01)
+        client = make_client(server.endpoint, cores=1, engine_factory=factory)
+        await client.start()
+        assert await wait_for(lambda: work_id in server.lichess.analyses)
+        await client.stop()
+        reports = server.lichess.progress_reports.get(work_id, [])
+        assert reports, "expected at least one progress report"
+        for report in reports:
+            assert report["analysis"][0] is None
+
+
+async def test_multipv_matrix_submission():
+    async with FakeServer() as server:
+        work_id = server.lichess.add_analysis_job(moves="e2e4", multipv=3, depth=14)
+        client = make_client(server.endpoint, cores=1)
+        await client.start()
+        assert await wait_for(lambda: work_id in server.lichess.analyses)
+        await client.stop()
+        parts = server.lichess.analyses[work_id]["analysis"]
+        part = parts[0]
+        assert isinstance(part["pv"], list)  # matrix form: multipv x depth
+        assert isinstance(part["score"], list)
+        assert len(part["score"]) == 3
+        # No progress reports for matrix batches (queue.rs:286-288).
+        assert work_id not in server.lichess.progress_reports
+
+
+async def test_key_check():
+    from fishnet_tpu.net.api import channel
+
+    async with FakeServer() as server:
+        logger = Logger()
+        stub, actor = channel(server.endpoint, VALID_KEY, logger)
+        task = asyncio.create_task(actor.run())
+        assert await stub.check_key() is None
+        actor.stop()
+        await asyncio.wait_for(task, 5)
+
+        stub2, actor2 = channel(server.endpoint, "WRONGKEY", logger)
+        task2 = asyncio.create_task(actor2.run())
+        err = await stub2.check_key()
+        assert err is not None
+        actor2.stop()
+        await asyncio.wait_for(task2, 5)
+
+
+async def test_variant_batch_routed_hce_or_ignored():
+    # Variants aren't implemented in the native core yet: the batch must be
+    # ignored cleanly (invalid-batch path), not crash the client.
+    async with FakeServer() as server:
+        bad = server.lichess.add_analysis_job(moves="e2e4", variant="atomic")
+        good = server.lichess.add_analysis_job(moves="e2e4")
+        client = make_client(server.endpoint, cores=1)
+        await client.start()
+        assert await wait_for(lambda: good in server.lichess.analyses)
+        await client.stop()
+        assert bad not in server.lichess.analyses
