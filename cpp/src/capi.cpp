@@ -7,6 +7,7 @@
 #include <new>
 #include <string>
 
+#include "nnue.h"
 #include "position.h"
 
 using namespace fc;
@@ -107,5 +108,37 @@ int fc_pos_legal_moves(const Position* pos, char* buf, int len) {
 unsigned long long fc_perft(const Position* pos, int depth) {
   return perft(*pos, depth);
 }
+
+// ---------------------------------------------------------------------------
+// NNUE
+// ---------------------------------------------------------------------------
+
+NnueNet* fc_nnue_load(const char* path, char* err, int errlen) {
+  NnueNet* net = new (std::nothrow) NnueNet();
+  if (!net) return nullptr;
+  std::string e = net->load(path ? path : "");
+  if (!e.empty()) {
+    if (err) copy_out(e, err, errlen);
+    delete net;
+    return nullptr;
+  }
+  return net;
+}
+
+void fc_nnue_free(NnueNet* net) { delete net; }
+
+int fc_nnue_evaluate(const NnueNet* net, const Position* pos) {
+  return nnue_evaluate(*net, *pos);
+}
+
+// HalfKAv2_hm features of one perspective (0 = side to move, 1 = other).
+// out must hold 32 int32s; returns the active count.
+int fc_pos_features(const Position* pos, int perspective_rel, int32_t* out) {
+  Color perspective = perspective_rel == 0 ? pos->stm : ~pos->stm;
+  return nnue_features(*pos, perspective, out);
+}
+
+// Layer-stack / PSQT bucket of the position.
+int fc_pos_psqt_bucket(const Position* pos) { return nnue_psqt_bucket(*pos); }
 
 }  // extern "C"

@@ -1,0 +1,168 @@
+#include "nnue.h"
+
+#include <cstring>
+#include <fstream>
+
+namespace fc {
+
+namespace {
+
+constexpr uint32_t FILE_VERSION = 0x7AF32F20;
+
+int32_t clamp32(int32_t v, int32_t lo, int32_t hi) {
+  return v < lo ? lo : (v > hi ? hi : v);
+}
+
+}  // namespace
+
+std::string NnueNet::load(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return "cannot open " + path;
+
+  auto read_u32 = [&](uint32_t& out) -> bool {
+    return bool(f.read(reinterpret_cast<char*>(&out), 4));
+  };
+  auto read_vec = [&](auto& vec, size_t count) -> bool {
+    vec.resize(count);
+    using T = typename std::remove_reference_t<decltype(vec)>::value_type;
+    return bool(f.read(reinterpret_cast<char*>(vec.data()), count * sizeof(T)));
+  };
+
+  uint32_t version, arch_hash, desc_len;
+  if (!read_u32(version) || !read_u32(arch_hash) || !read_u32(desc_len))
+    return "truncated header";
+  if (version != FILE_VERSION) return "unsupported version";
+  if (arch_hash != 0x3E5AA6EEu) return "wrong architecture hash";
+  f.seekg(desc_len, std::ios::cur);
+
+  uint32_t section_hash;
+  if (!read_u32(section_hash)) return "truncated ft hash";
+  if (!read_vec(ft_bias, NNUE_L1)) return "truncated ft bias";
+  if (!read_vec(ft_weight, size_t(NNUE_FEATURES) * NNUE_L1)) return "truncated ft weight";
+  if (!read_vec(ft_psqt, size_t(NNUE_FEATURES) * NNUE_PSQT_BUCKETS))
+    return "truncated ft psqt";
+
+  l1_weight.resize(size_t(NNUE_PSQT_BUCKETS) * (NNUE_L2 + 1) * NNUE_L1);
+  l1_bias.resize(size_t(NNUE_PSQT_BUCKETS) * (NNUE_L2 + 1));
+  l2_weight.resize(size_t(NNUE_PSQT_BUCKETS) * NNUE_L3 * 2 * NNUE_L2);
+  l2_bias.resize(size_t(NNUE_PSQT_BUCKETS) * NNUE_L3);
+  out_weight.resize(size_t(NNUE_PSQT_BUCKETS) * NNUE_L3);
+  out_bias.resize(NNUE_PSQT_BUCKETS);
+
+  for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) {
+    if (!read_u32(section_hash)) return "truncated stack hash";
+    if (!f.read(reinterpret_cast<char*>(&l1_bias[b * (NNUE_L2 + 1)]),
+                (NNUE_L2 + 1) * 4))
+      return "truncated l1 bias";
+    if (!f.read(reinterpret_cast<char*>(&l1_weight[size_t(b) * (NNUE_L2 + 1) * NNUE_L1]),
+                (NNUE_L2 + 1) * NNUE_L1))
+      return "truncated l1 weight";
+    if (!f.read(reinterpret_cast<char*>(&l2_bias[b * NNUE_L3]), NNUE_L3 * 4))
+      return "truncated l2 bias";
+    if (!f.read(reinterpret_cast<char*>(&l2_weight[size_t(b) * NNUE_L3 * 2 * NNUE_L2]),
+                NNUE_L3 * 2 * NNUE_L2))
+      return "truncated l2 weight";
+    if (!f.read(reinterpret_cast<char*>(&out_bias[b]), 4)) return "truncated out bias";
+    if (!f.read(reinterpret_cast<char*>(&out_weight[b * NNUE_L3]), NNUE_L3))
+      return "truncated out weight";
+  }
+  return "";
+}
+
+int nnue_features(const Position& pos, Color perspective, int32_t* out) {
+  Square ksq = pos.king_sq(perspective);
+  int flip = perspective == BLACK ? 56 : 0;
+  int k0 = ksq ^ flip;
+  int mirror = file_of(k0) >= 4 ? 7 : 0;
+  int okq = k0 ^ mirror;
+  int bucket = rank_of(okq) * 4 + file_of(okq);
+  int base = bucket * (NNUE_PLANES * 64);
+
+  int n = 0;
+  Bitboard occ = pos.occupied();
+  while (occ) {
+    Square s = pop_lsb(occ);
+    int pc = pos.piece_on(s);
+    PieceType t = piece_type(pc);
+    Color c = piece_color(pc);
+    int plane = t == KING ? 10 : 2 * int(t) + (c != perspective ? 1 : 0);
+    int osq = s ^ flip ^ mirror;
+    out[n++] = base + plane * 64 + osq;
+  }
+  return n;
+}
+
+int nnue_evaluate(const NnueNet& net, const Position& pos) {
+  int32_t acc[COLOR_NB][NNUE_L1];
+  int32_t psqt[COLOR_NB][NNUE_PSQT_BUCKETS];
+
+  Color stm = pos.stm;
+  for (int p = 0; p < COLOR_NB; p++) {
+    Color perspective = p == 0 ? stm : ~stm;  // stm first
+    int32_t feats[NNUE_MAX_ACTIVE];
+    int n = nnue_features(pos, perspective, feats);
+
+    for (int i = 0; i < NNUE_L1; i++) acc[p][i] = net.ft_bias[i];
+    for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) psqt[p][b] = 0;
+    for (int j = 0; j < n; j++) {
+      const int16_t* row = &net.ft_weight[size_t(feats[j]) * NNUE_L1];
+      for (int i = 0; i < NNUE_L1; i++) acc[p][i] += row[i];
+      const int32_t* prow = &net.ft_psqt[size_t(feats[j]) * NNUE_PSQT_BUCKETS];
+      for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) psqt[p][b] += prow[b];
+    }
+  }
+
+  // Pairwise clipped multiply, stm perspective first.
+  uint8_t x[NNUE_L1];
+  for (int p = 0; p < COLOR_NB; p++) {
+    for (int i = 0; i < NNUE_L1_HALF; i++) {
+      int32_t a = clamp32(acc[p][i], 0, 127);
+      int32_t b = clamp32(acc[p][i + NNUE_L1_HALF], 0, 127);
+      x[p * NNUE_L1_HALF + i] = uint8_t((a * b) >> 7);
+    }
+  }
+
+  int bucket = nnue_psqt_bucket(pos);
+
+  // l1: 1024 -> 16
+  int32_t y[NNUE_L2 + 1];
+  for (int o = 0; o < NNUE_L2 + 1; o++) {
+    const int8_t* row =
+        &net.l1_weight[(size_t(bucket) * (NNUE_L2 + 1) + o) * NNUE_L1];
+    int32_t sum = net.l1_bias[bucket * (NNUE_L2 + 1) + o];
+    for (int i = 0; i < NNUE_L1; i++) sum += int32_t(row[i]) * x[i];
+    y[o] = sum;
+  }
+  int32_t skip = y[NNUE_L2];
+
+  // Activations: squared-clipped then clipped, concatenated (30 values).
+  int32_t act[2 * NNUE_L2];
+  for (int o = 0; o < NNUE_L2; o++) {
+    int64_t sq = (int64_t(y[o]) * y[o]) >> 19;
+    act[o] = int32_t(sq > 127 ? 127 : sq);
+    act[NNUE_L2 + o] = clamp32(y[o] >> 6, 0, 127);
+  }
+
+  // l2: 30 -> 32
+  int32_t z[NNUE_L3];
+  for (int o = 0; o < NNUE_L3; o++) {
+    const int8_t* row =
+        &net.l2_weight[(size_t(bucket) * NNUE_L3 + o) * 2 * NNUE_L2];
+    int32_t sum = net.l2_bias[bucket * NNUE_L3 + o];
+    for (int i = 0; i < 2 * NNUE_L2; i++) sum += int32_t(row[i]) * act[i];
+    z[o] = clamp32(sum >> 6, 0, 127);
+  }
+
+  // out: 32 -> 1
+  const int8_t* orow = &net.out_weight[size_t(bucket) * NNUE_L3];
+  int32_t v = net.out_bias[bucket];
+  for (int i = 0; i < NNUE_L3; i++) v += int32_t(orow[i]) * z[i];
+
+  int32_t material = (psqt[0][bucket] - psqt[1][bucket]) / 2;
+  // skip * 9600 / 8128, reduced to stay within int32 (= skip + skip*23/127;
+  // exact under C truncation since skip*8128/8128 has no remainder).
+  int32_t positional = v + skip + (skip * 23) / 127;
+  return (positional + material) / 16;
+}
+
+}  // namespace fc

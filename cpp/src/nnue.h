@@ -1,0 +1,57 @@
+// Scalar NNUE evaluator: the bit-exact reference implementation of the
+// architecture specified in fishnet_tpu/nnue/spec.py. Serves as the
+// score-parity oracle for the JAX evaluator and as the CPU fallback eval
+// for the search core.
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "position.h"
+
+namespace fc {
+
+constexpr int NNUE_PLANES = 11;
+constexpr int NNUE_KING_BUCKETS = 32;
+constexpr int NNUE_FEATURES = NNUE_KING_BUCKETS * NNUE_PLANES * 64;  // 22528
+constexpr int NNUE_MAX_ACTIVE = 32;
+constexpr int NNUE_L1 = 1024;
+constexpr int NNUE_L1_HALF = NNUE_L1 / 2;
+constexpr int NNUE_PSQT_BUCKETS = 8;
+constexpr int NNUE_L2 = 15;
+constexpr int NNUE_L3 = 32;
+
+struct NnueNet {
+  std::vector<int16_t> ft_weight;  // [FEATURES][L1]
+  std::vector<int16_t> ft_bias;    // [L1]
+  std::vector<int32_t> ft_psqt;    // [FEATURES][PSQT_BUCKETS]
+  // Layer stacks, bucket-major.
+  std::vector<int8_t> l1_weight;   // [8][L2+1][L1]
+  std::vector<int32_t> l1_bias;    // [8][L2+1]
+  std::vector<int8_t> l2_weight;   // [8][L3][2*L2]
+  std::vector<int32_t> l2_bias;    // [8][L3]
+  std::vector<int8_t> out_weight;  // [8][1][L3]
+  std::vector<int32_t> out_bias;   // [8][1]
+
+  // Returns empty string on success.
+  std::string load(const std::string& path);
+};
+
+// HalfKAv2_hm active features for one perspective. Writes feature indices
+// to out (capacity NNUE_MAX_ACTIVE); returns the count.
+int nnue_features(const Position& pos, Color perspective, int32_t* out);
+
+// Layer-stack / PSQT bucket: (piece count - 1) / 4, clamped.
+inline int nnue_psqt_bucket(const Position& pos) {
+  int bucket = (popcount(pos.occupied()) - 1) / 4;
+  return bucket < 0 ? 0
+         : bucket >= NNUE_PSQT_BUCKETS ? NNUE_PSQT_BUCKETS - 1
+                                       : bucket;
+}
+
+// Full evaluation in centipawns from the side-to-move's point of view.
+int nnue_evaluate(const NnueNet& net, const Position& pos);
+
+}  // namespace fc

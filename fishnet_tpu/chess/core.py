@@ -103,6 +103,20 @@ def load() -> ctypes.CDLL:
         lib.fc_perft.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.fc_perft.restype = ctypes.c_uint64
 
+        lib.fc_nnue_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.fc_nnue_load.restype = ctypes.c_void_p
+        lib.fc_nnue_free.argtypes = [ctypes.c_void_p]
+        lib.fc_nnue_evaluate.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.fc_nnue_evaluate.restype = ctypes.c_int
+        lib.fc_pos_features.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.fc_pos_features.restype = ctypes.c_int
+        lib.fc_pos_psqt_bucket.argtypes = [ctypes.c_void_p]
+        lib.fc_pos_psqt_bucket.restype = ctypes.c_int
+
         lib.fc_init()
         _lib = lib
         return lib

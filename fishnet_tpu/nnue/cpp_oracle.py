@@ -1,0 +1,31 @@
+"""Python handle to the C++ scalar NNUE evaluator — the parity oracle."""
+
+from __future__ import annotations
+
+import ctypes
+from pathlib import Path
+from typing import Union
+
+from fishnet_tpu.chess.board import Board
+from fishnet_tpu.chess.core import NativeCoreError, load
+
+
+class CppNnue:
+    def __init__(self, path: Union[str, Path]) -> None:
+        self._lib = load()
+        err = ctypes.create_string_buffer(256)
+        self._net = self._lib.fc_nnue_load(str(path).encode(), err, len(err))
+        if not self._net:
+            raise NativeCoreError(
+                f"failed to load nnue {path}: {err.value.decode(errors='replace')}"
+            )
+
+    def __del__(self) -> None:
+        net = getattr(self, "_net", None)
+        if net:
+            self._lib.fc_nnue_free(net)
+            self._net = None
+
+    def evaluate(self, board: Board) -> int:
+        """Centipawn score from the side to move's point of view."""
+        return self._lib.fc_nnue_evaluate(self._net, board._pos)

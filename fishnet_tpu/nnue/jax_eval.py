@@ -1,0 +1,134 @@
+"""Batched NNUE evaluation in JAX.
+
+This is the TPU replacement for the reference's per-process CPU NNUE
+(SURVEY.md §0): instead of one position at a time inside a Stockfish
+subprocess, whole microbatches of positions are evaluated in one XLA
+program. Two paths:
+
+* ``evaluate_batch`` — exact integer semantics, bit-identical to the C++
+  scalar oracle (cpp/src/nnue.cpp). Used for score-parity tests and when
+  exactness matters more than speed.
+* the same function is MXU-friendly: the small dense layers run as int8 x
+  int8 -> int32 einsums over all 8 buckets with a final per-position
+  bucket select (compute-all-select beats a gather of tiny weight
+  matrices on TPU), and the feature-transformer gather is a plain
+  embedding take+sum that XLA lowers to dynamic-gather + reduce. A fused
+  Pallas kernel for the gather lives in fishnet_tpu/ops/.
+
+Input convention: ``indices`` is int32 [B, 2, MAX_ACTIVE] of HalfKAv2_hm
+feature indices — perspective 0 is the side to move — padded with
+``NUM_FEATURES`` (a sentinel row of zeros appended to the weights), as
+produced by the native core's ``fc_pos_features``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fishnet_tpu.nnue import spec
+from fishnet_tpu.nnue.weights import NnueWeights
+
+Params = Dict[str, jax.Array]
+
+
+def params_from_weights(weights: NnueWeights) -> Params:
+    """Device-ready parameter pytree. The FT tables get a zero sentinel
+    row at index NUM_FEATURES so padded feature slots are no-ops."""
+    ft_w = np.vstack([weights.ft_weight, np.zeros((1, spec.L1), np.int16)])
+    ft_psqt = np.vstack(
+        [weights.ft_psqt, np.zeros((1, spec.NUM_PSQT_BUCKETS), np.int32)]
+    )
+    return {
+        "ft_w": jnp.asarray(ft_w),
+        "ft_b": jnp.asarray(weights.ft_bias),
+        "ft_psqt": jnp.asarray(ft_psqt),
+        "l1_w": jnp.asarray(weights.l1_weight),
+        "l1_b": jnp.asarray(weights.l1_bias),
+        "l2_w": jnp.asarray(weights.l2_weight),
+        "l2_b": jnp.asarray(weights.l2_bias),
+        "out_w": jnp.asarray(weights.out_weight),
+        "out_b": jnp.asarray(weights.out_bias),
+    }
+
+
+def _trunc_div(a: jax.Array, d: int) -> jax.Array:
+    """C-style truncating integer division by a positive constant
+    (jnp // floors; lax.div on ints truncates)."""
+    return jax.lax.div(a, jnp.int32(d))
+
+
+def evaluate_batch(params: Params, indices: jax.Array, buckets: jax.Array) -> jax.Array:
+    """Evaluate a batch. indices: int32 [B, 2, 32] (stm perspective first,
+    padded with NUM_FEATURES); buckets: int32 [B]. Returns int32 [B]
+    centipawn scores from the side to move's point of view."""
+    # Feature transformer: embedding gather + sum (int32 accumulation).
+    rows = jnp.take(params["ft_w"], indices, axis=0)  # [B, 2, 32, L1] int16
+    acc = params["ft_b"].astype(jnp.int32) + jnp.sum(
+        rows.astype(jnp.int32), axis=2
+    )  # [B, 2, L1]
+    psqt_rows = jnp.take(params["ft_psqt"], indices, axis=0)  # [B, 2, 32, 8]
+    psqt = jnp.sum(psqt_rows, axis=2)  # [B, 2, 8] int32
+
+    # Clipped pairwise multiply; stm half first.
+    c = jnp.clip(acc, 0, spec.FT_CLIP)
+    pair = (c[..., : spec.L1_HALF] * c[..., spec.L1_HALF :]) >> spec.PAIRWISE_SHIFT
+    x = pair.reshape(pair.shape[0], spec.L1)  # [B, 1024] in 0..126
+
+    # l1 over all 8 buckets on the MXU, then per-position select.
+    y_all = (
+        jnp.einsum(
+            "bi,koi->bko",
+            x.astype(jnp.int8),
+            params["l1_w"],
+            preferred_element_type=jnp.int32,
+        )
+        + params["l1_b"][None, :, :]
+    )  # [B, 8, 16]
+    y = jnp.take_along_axis(y_all, buckets[:, None, None], axis=1)[:, 0]  # [B, 16]
+
+    skip = y[:, spec.L2]
+    h = y[:, : spec.L2]
+
+    # sqr-clipped: clamp |h| first so h*h stays in int32; values past the
+    # clamp square to >= 127 anyway (see nnue.cpp for the same identity).
+    hs = jnp.clip(h, -8192, 8192)
+    sq = jnp.minimum((hs * hs) >> spec.SQR_SHIFT, spec.FT_CLIP)
+    ca = jnp.clip(h >> spec.WEIGHT_SCALE_BITS, 0, spec.FT_CLIP)
+    act = jnp.concatenate([sq, ca], axis=1)  # [B, 30] in 0..127
+
+    z_all = (
+        jnp.einsum(
+            "bi,koi->bko",
+            act.astype(jnp.int8),
+            params["l2_w"],
+            preferred_element_type=jnp.int32,
+        )
+        + params["l2_b"][None, :, :]
+    )  # [B, 8, 32]
+    z = jnp.take_along_axis(z_all, buckets[:, None, None], axis=1)[:, 0]
+    z = jnp.clip(z >> spec.WEIGHT_SCALE_BITS, 0, spec.FT_CLIP)
+
+    v_all = (
+        jnp.einsum(
+            "bi,koi->bko",
+            z.astype(jnp.int8),
+            params["out_w"],
+            preferred_element_type=jnp.int32,
+        )
+        + params["out_b"][None, :, :]
+    )  # [B, 8, 1]
+    v = jnp.take_along_axis(v_all, buckets[:, None, None], axis=1)[:, 0, 0]
+
+    psqt_sel = jnp.take_along_axis(
+        psqt, jnp.repeat(buckets[:, None, None], 2, axis=1), axis=2
+    )[..., 0]
+    material = _trunc_div(psqt_sel[:, 0] - psqt_sel[:, 1], 2)
+    positional = v + skip + _trunc_div(skip * 23, 127)
+    return _trunc_div(positional + material, spec.FV_SCALE)
+
+
+evaluate_batch_jit = jax.jit(evaluate_batch)

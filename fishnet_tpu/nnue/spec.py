@@ -1,0 +1,90 @@
+"""The fishnet-tpu NNUE architecture specification.
+
+Architecture: **HalfKAv2_hm feature set + SFNNv5-shaped network**, the
+family used by the reference's embedded Stockfish 15 net
+(``nn-ad9b42354671.nnue``, reference build.rs:7). All tensor shapes and
+the serialization layout follow the public Stockfish/nnue-pytorch
+format; the quantized arithmetic below is specified exactly so that the
+C++ scalar evaluator (cpp/src/nnue.cpp) and the batched JAX evaluator
+(fishnet_tpu/nnue/jax_eval.py) are bit-identical — that equivalence is
+this framework's score-parity oracle (SURVEY.md §4), since no pretrained
+net ships in this environment.
+
+Feature set (HalfKAv2_hm):
+    For each perspective p (side to move first):
+      k0      = ksq(p) ^ (p == BLACK ? 56 : 0)        # color flip
+      mirror  = file(k0) >= 4                          # horizontal mirror
+      okq     = k0 ^ (mirror ? 7 : 0)
+      bucket  = rank(okq) * 4 + file(okq)              # 0..31
+      For every piece (c, t) on square s:
+        osq   = s ^ (p == BLACK ? 56 : 0) ^ (mirror ? 7 : 0)
+        plane = t == KING ? 10 : 2 * t + (c != p)      # 0..10
+        index = bucket * 704 + plane * 64 + osq        # 0..22527
+    <= 32 active features per perspective (all pieces incl. both kings).
+
+Network (int quantization in brackets):
+    ft:    22528 -> 1024 [w,b int16] + 8 PSQT outputs [int32]
+    acc    = b + sum of active rows            (int32 math, int16 range)
+    c      = clamp(acc, 0, 127)
+    pair_i = (c_i * c_{i+512}) >> 7            # 512 per perspective, u8
+    x      = concat(pair[stm], pair[opp])      # 1024
+    bucket = (popcount(occupied) - 1) // 4     # 8 layer-stack buckets
+    l1:    1024 -> 16 [w int8, b int32]; y = W x + b
+    skip   = y[15]                             # direct residual neuron
+    h      = y[0:15]
+    act    = concat(min(127, (h*h) >> 19), clamp(h >> 6, 0, 127))  # 30
+    l2:    30 -> 32 [w int8, b int32]; z = clamp((W act + b) >> 6, 0, 127)
+    out:   32 -> 1  [w int8, b int32]; v = W z + b
+    material   = (psqt[stm][bucket] - psqt[opp][bucket]) / 2
+    positional = v + skip + (skip * 23) / 127   # == v + skip*9600/8128
+    value      = (positional + material) / 16           # centipawns
+
+    All `/` above are C-style truncating integer divisions (toward zero);
+    all `>>` are arithmetic (flooring) shifts. The JAX evaluator
+    reproduces exactly these semantics.
+
+Divergence note vs. real SF15 nets: the arithmetic above follows the
+published SFNNv5 operator set (SqrClippedReLU >> 19, ClippedReLU >> 6,
+pairwise >> 7, FV_SCALE 16); exact parity with stock Stockfish on its
+shipped net cannot be validated offline, so the authoritative contract
+is C++ == JAX on any weights this framework loads or trains.
+"""
+
+from __future__ import annotations
+
+# Feature transformer
+NUM_PLANES = 11
+NUM_SQ = 64
+NUM_KING_BUCKETS = 32
+FEATURES_PER_BUCKET = NUM_PLANES * NUM_SQ  # 704
+NUM_FEATURES = NUM_KING_BUCKETS * FEATURES_PER_BUCKET  # 22528
+MAX_ACTIVE_FEATURES = 32
+
+L1 = 1024  # feature-transformer width
+L1_HALF = L1 // 2  # pairwise-multiplied halves
+NUM_PSQT_BUCKETS = 8
+L2 = 15  # l1 outputs going through activations (+1 skip neuron)
+L3 = 32
+
+# Quantization
+FT_CLIP = 127
+PAIRWISE_SHIFT = 7
+WEIGHT_SCALE_BITS = 6
+SQR_SHIFT = 2 * WEIGHT_SCALE_BITS + PAIRWISE_SHIFT  # 19
+FV_SCALE = 16
+SKIP_NUM = 600 * FV_SCALE  # skip-neuron scale numerator
+SKIP_DEN = 127 * (1 << WEIGHT_SCALE_BITS)
+
+# Serialization (little-endian), nnue-pytorch/SF compatible framing
+FILE_VERSION = 0x7AF32F20
+ARCH_HASH = 0x3E5AA6EE  # HalfKAv2_hm + SFNNv5 stack (public constant)
+ARCH_DESCRIPTION = (
+    b"Features=HalfKAv2_hm(Friend)[22528->1024x2],"
+    b"Network=AffineTransform[1->32](ClippedReLU[32](AffineTransform[32->30]"
+    b"(SqrClippedReLU+ClippedReLU[15](AffineTransform[15+1<-1024]))))"
+)
+
+
+def psqt_bucket(piece_count: int) -> int:
+    """Layer-stack / PSQT bucket from total piece count (1..32)."""
+    return min(NUM_PSQT_BUCKETS - 1, max(0, (piece_count - 1) // 4))
