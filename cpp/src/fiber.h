@@ -1,0 +1,101 @@
+// Minimal stackful-coroutine wrapper over ucontext, used to suspend an
+// alpha-beta search at each leaf evaluation so thousands of searches can
+// share one TPU eval microbatch.
+//
+// This replaces the reference's parallelism unit: where fishnet runs one
+// blocking single-threaded engine *process* per core (src/main.rs:158-170),
+// fishnet-tpu runs thousands of cooperative search fibers per host thread,
+// all yielding leaf positions into a shared evaluator batch (SURVEY.md §7
+// "the inversion that makes this TPU-shaped").
+
+#pragma once
+
+#include <sys/mman.h>
+#include <ucontext.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <functional>
+
+namespace fc {
+
+class Fiber {
+ public:
+  // The stack is mmap'd with a PROT_NONE guard page below it, so a search
+  // recursion overflowing the stack faults immediately instead of
+  // silently corrupting neighboring slots' heap state. Worst case
+  // (MAX_PLY alpha-beta frames + qsearch tail, ~2.5 KB/frame) fits in
+  // 512 KB with headroom; pages are only committed when touched.
+  explicit Fiber(size_t stack_size = 512 * 1024) : stack_size_(stack_size) {
+    size_t page = size_t(sysconf(_SC_PAGESIZE));
+    map_size_ = stack_size_ + page;
+    void* map = mmap(nullptr, map_size_, PROT_READ | PROT_WRITE,
+                     MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+    if (map == MAP_FAILED) {
+      map_ = nullptr;
+      stack_ = nullptr;
+      return;
+    }
+    map_ = static_cast<char*>(map);
+    mprotect(map_, page, PROT_NONE);  // guard page at the low end
+    stack_ = map_ + page;
+  }
+
+  ~Fiber() {
+    if (map_) munmap(map_, map_size_);
+  }
+
+  bool valid() const { return stack_ != nullptr; }
+
+  Fiber(const Fiber&) = delete;
+  Fiber& operator=(const Fiber&) = delete;
+
+  // Start running fn on this fiber. fn runs until it yields or returns.
+  void start(std::function<void()> fn) {
+    fn_ = std::move(fn);
+    done_ = false;
+    getcontext(&ctx_);
+    ctx_.uc_stack.ss_sp = stack_;
+    ctx_.uc_stack.ss_size = stack_size_;
+    ctx_.uc_link = &caller_;
+    makecontext(&ctx_, reinterpret_cast<void (*)()>(&Fiber::trampoline), 1, this);
+    resume();
+  }
+
+  // Resume the fiber until its next yield() or completion.
+  void resume() {
+    current_ = this;
+    swapcontext(&caller_, &ctx_);
+    current_ = nullptr;
+  }
+
+  // Called from inside the fiber: return control to the scheduler.
+  void yield() { swapcontext(&ctx_, &caller_); }
+
+  bool done() const { return done_; }
+
+  // The fiber currently executing on this thread (nullptr outside fibers).
+  static Fiber* current() { return current_; }
+
+ private:
+  static void trampoline(Fiber* self) {
+    self->fn_();
+    self->done_ = true;
+    // returning switches to uc_link (the caller context)
+  }
+
+  ucontext_t ctx_{};
+  ucontext_t caller_{};
+  char* map_ = nullptr;
+  size_t map_size_ = 0;
+  char* stack_;
+  size_t stack_size_;
+  std::function<void()> fn_;
+  bool done_ = true;
+  static thread_local Fiber* current_;
+};
+
+inline thread_local Fiber* Fiber::current_ = nullptr;
+
+}  // namespace fc

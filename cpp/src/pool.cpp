@@ -1,0 +1,329 @@
+// SearchPool: many concurrent alpha-beta searches as cooperative fibers,
+// all yielding leaf evaluations into one shared microbatch.
+//
+// This is the TPU-shaped inversion of the reference's engine tier
+// (SURVEY.md §7): instead of N independent engine processes each
+// evaluating one position at a time on its own CPU core, N search fibers
+// suspend at their leaves; the host collects up to `capacity` pending
+// evaluations per step, ships them to the JAX/TPU evaluator in one batch,
+// and resumes every fiber with its score.
+//
+// Driving loop (Python side, engine/tpu_engine.py):
+//   submit(...) per position  ->  loop {
+//     n = fc_pool_step(feats, buckets, slots)   # run fibers to their leaves
+//     if n == 0 and nothing active: break
+//     values = jax_evaluate(feats[:n])          # one TPU microbatch
+//     fc_pool_provide(values, n)                # wake the fibers
+//   }  -> fc_pool_finished() / fc_pool_result_*()
+//
+// The pool is single-threaded (one scheduler thread at a time); the
+// shared transposition table needs no locks and lets positions from the
+// same game (adjacent plies across batch positions) share work.
+
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "fiber.h"
+#include "nnue.h"
+#include "position.h"
+#include "search.h"
+
+namespace fc {
+namespace {
+
+int copy_str(const std::string& s, char* buf, int len) {
+  if (!buf || len <= 0 || int(s.size()) + 1 > len) return -1;
+  memcpy(buf, s.c_str(), s.size() + 1);
+  return int(s.size());
+}
+
+struct Slot;
+
+// EvalBridge that extracts features and suspends the calling fiber.
+class BatchedEval : public EvalBridge {
+ public:
+  explicit BatchedEval(Slot* slot) : slot_(slot) {}
+  int evaluate(const Position& pos) override;
+
+ private:
+  Slot* slot_;
+};
+
+struct Slot {
+  std::unique_ptr<Fiber> fiber;
+  std::unique_ptr<Search> search;
+  std::unique_ptr<BatchedEval> bridge;
+  Position root;
+  std::vector<uint64_t> history;
+  SearchLimits limits;
+  SearchResult result;
+  bool active = false;     // submitted, not yet released
+  bool started = false;    // fiber launched
+  bool finished = false;   // search complete, result ready
+  bool wants_eval = false; // suspended waiting for a score
+  bool use_scalar = false; // evaluate immediately with the scalar net
+  bool stop_requested = false;
+  // Eval request state (valid while wants_eval).
+  int32_t features[2][NNUE_MAX_ACTIVE];
+  int bucket = 0;
+  int32_t eval_value = 0;
+};
+
+int BatchedEval::evaluate(const Position& pos) {
+  for (int p = 0; p < 2; p++) {
+    int n = nnue_features(pos, p == 0 ? pos.stm : ~pos.stm, slot_->features[p]);
+    for (int i = n; i < NNUE_MAX_ACTIVE; i++) slot_->features[p][i] = NNUE_FEATURES;
+  }
+  slot_->bucket = nnue_psqt_bucket(pos);
+  slot_->wants_eval = true;
+  slot_->fiber->yield();
+  slot_->wants_eval = false;
+  return slot_->eval_value;
+}
+
+}  // namespace
+
+struct SearchPool {
+  TranspositionTable tt;
+  std::unique_ptr<NnueNet> scalar_net;
+  std::unique_ptr<ScalarEval> scalar_eval;
+  std::vector<std::unique_ptr<Slot>> slots;
+  std::vector<int> last_batch;   // slot ids of the last step()'s evals
+  std::deque<int> finished_queue;
+  size_t fiber_stack = 256 * 1024;
+
+  SearchPool(int max_slots, size_t tt_bytes) : tt(tt_bytes) {
+    slots.resize(max_slots);
+    for (auto& s : slots) s = std::make_unique<Slot>();
+  }
+};
+
+extern "C" {
+
+SearchPool* fc_pool_new(int max_slots, uint64_t tt_bytes,
+                        const char* scalar_net_path) {
+  init_bitboards();
+  init_zobrist();
+  auto* pool = new (std::nothrow) SearchPool(
+      max_slots > 0 ? max_slots : 256,
+      tt_bytes ? size_t(tt_bytes) : (64ull << 20));
+  if (!pool) return nullptr;
+  if (scalar_net_path && scalar_net_path[0]) {
+    pool->scalar_net = std::make_unique<NnueNet>();
+    if (!pool->scalar_net->load(scalar_net_path).empty()) {
+      delete pool;
+      return nullptr;
+    }
+    pool->scalar_eval = std::make_unique<ScalarEval>(pool->scalar_net.get());
+  }
+  return pool;
+}
+
+void fc_pool_free(SearchPool* pool) { delete pool; }
+
+// Submit a search. moves: space-separated UCI from the root fen (the game
+// line, for history/repetitions). Returns the slot id, or -1 if the pool
+// is full / input invalid.
+int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
+                   uint64_t nodes, int depth, int multipv, int use_scalar) {
+  int id = -1;
+  for (size_t i = 0; i < pool->slots.size(); i++)
+    if (!pool->slots[i]->active) {
+      id = int(i);
+      break;
+    }
+  if (id < 0) return -1;
+  Slot& slot = *pool->slots[id];
+
+  Position pos;
+  if (!pos.set_fen(fen ? fen : "", VR_STANDARD).empty()) return -2;
+  slot.history.clear();
+  slot.history.push_back(pos.hash);
+  if (moves && moves[0]) {
+    std::string all(moves);
+    size_t start = 0;
+    while (start < all.size()) {
+      size_t end = all.find(' ', start);
+      if (end == std::string::npos) end = all.size();
+      std::string uci = all.substr(start, end - start);
+      start = end + 1;
+      if (uci.empty()) continue;
+      Move m = pos.parse_uci(uci);
+      if (m == MOVE_NONE) return -3;
+      pos.make(m);
+      slot.history.push_back(pos.hash);
+    }
+  }
+
+  slot.root = pos;
+  slot.limits.nodes = nodes;
+  slot.limits.depth = depth;
+  slot.limits.multipv = multipv;
+  slot.stop_requested = false;
+  slot.limits.stop = &slot.stop_requested;
+  slot.use_scalar = use_scalar != 0 && pool->scalar_eval != nullptr;
+  slot.active = true;
+  slot.started = false;
+  slot.finished = false;
+  slot.wants_eval = false;
+  slot.result = SearchResult();
+  if (!slot.fiber) slot.fiber = std::make_unique<Fiber>(pool->fiber_stack);
+  if (!slot.bridge) slot.bridge = std::make_unique<BatchedEval>(&slot);
+  return id;
+}
+
+void fc_pool_stop(SearchPool* pool, int slot_id) {
+  if (slot_id >= 0 && slot_id < int(pool->slots.size()))
+    pool->slots[slot_id]->stop_requested = true;
+}
+
+// Run all runnable fibers until each is blocked on an eval or finished.
+// Writes up to `capacity` pending eval requests (features [i][2][32],
+// bucket [i], slot id [i]) and returns the count. Returns 0 when no
+// fiber is waiting for evals (check fc_pool_finished for results).
+int fc_pool_step(SearchPool* pool, int32_t* out_features, int32_t* out_buckets,
+                 int32_t* out_slots, int capacity) {
+  pool->last_batch.clear();
+
+  for (size_t i = 0; i < pool->slots.size(); i++) {
+    Slot& slot = *pool->slots[i];
+    if (!slot.active || slot.finished || slot.wants_eval) continue;
+
+    if (!slot.started) {
+      if (int(pool->last_batch.size()) >= capacity) continue;  // defer launch
+      slot.started = true;
+      Slot* sp = &slot;
+      SearchPool* pp = pool;
+      EvalBridge* eval = slot.use_scalar
+                             ? static_cast<EvalBridge*>(pp->scalar_eval.get())
+                             : static_cast<EvalBridge*>(slot.bridge.get());
+      slot.search = std::make_unique<Search>(&pp->tt, eval);
+      slot.fiber->start([sp] {
+        sp->result = sp->search->run(sp->root, sp->history, sp->limits);
+      });
+    } else {
+      slot.fiber->resume();
+    }
+
+    if (slot.fiber->done()) {
+      slot.finished = true;
+      pool->finished_queue.push_back(int(i));
+    } else if (slot.wants_eval) {
+      if (int(pool->last_batch.size()) < capacity) {
+        int idx = int(pool->last_batch.size());
+        memcpy(out_features + size_t(idx) * 2 * NNUE_MAX_ACTIVE, slot.features,
+               sizeof(slot.features));
+        out_buckets[idx] = slot.bucket;
+        out_slots[idx] = int(i);
+        pool->last_batch.push_back(int(i));
+      }
+      // Slots beyond capacity stay suspended; they are picked up by the
+      // next step() because wants_eval stays true and they appear in the
+      // scan below.
+    }
+  }
+
+  // Include fibers still waiting from a previous over-capacity step.
+  if (int(pool->last_batch.size()) < capacity) {
+    for (size_t i = 0; i < pool->slots.size(); i++) {
+      Slot& slot = *pool->slots[i];
+      if (!slot.active || slot.finished || !slot.wants_eval) continue;
+      bool already = false;
+      for (int id : pool->last_batch)
+        if (id == int(i)) {
+          already = true;
+          break;
+        }
+      if (already) continue;
+      if (int(pool->last_batch.size()) >= capacity) break;
+      int idx = int(pool->last_batch.size());
+      memcpy(out_features + size_t(idx) * 2 * NNUE_MAX_ACTIVE, slot.features,
+             sizeof(slot.features));
+      out_buckets[idx] = slot.bucket;
+      out_slots[idx] = int(i);
+      pool->last_batch.push_back(int(i));
+    }
+  }
+
+  return int(pool->last_batch.size());
+}
+
+// Provide centipawn scores for the last step()'s batch, in order.
+// The fibers resume on the next fc_pool_step call.
+void fc_pool_provide(SearchPool* pool, const int32_t* values, int n) {
+  for (int i = 0; i < n && i < int(pool->last_batch.size()); i++) {
+    Slot& slot = *pool->slots[pool->last_batch[i]];
+    slot.eval_value = values[i];
+    slot.wants_eval = false;  // runnable again
+  }
+  pool->last_batch.clear();
+}
+
+// Number of slots still working (active and not finished).
+int fc_pool_active(SearchPool* pool) {
+  int n = 0;
+  for (auto& s : pool->slots)
+    if (s->active && !s->finished) n++;
+  return n;
+}
+
+// Drain one finished slot id, or -1.
+int fc_pool_next_finished(SearchPool* pool) {
+  if (pool->finished_queue.empty()) return -1;
+  int id = pool->finished_queue.front();
+  pool->finished_queue.pop_front();
+  return id;
+}
+
+int fc_pool_result_summary(SearchPool* pool, int slot_id, uint64_t* nodes,
+                           int32_t* depth, char* bestmove, int bmlen,
+                           int32_t* nlines) {
+  if (slot_id < 0 || slot_id >= int(pool->slots.size())) return -1;
+  Slot& slot = *pool->slots[slot_id];
+  if (!slot.finished) return -1;
+  *nodes = slot.result.nodes;
+  *depth = slot.result.depth;
+  *nlines = int32_t(slot.result.lines.size());
+  std::string bm = slot.result.best_move == MOVE_NONE
+                       ? ""
+                       : slot.root.uci(slot.result.best_move);
+  return copy_str(bm, bestmove, bmlen);
+}
+
+int fc_pool_result_line(SearchPool* pool, int slot_id, int line_idx,
+                        int32_t* multipv, int32_t* depth, int32_t* is_mate,
+                        int32_t* value, char* pv, int pvlen) {
+  if (slot_id < 0 || slot_id >= int(pool->slots.size())) return -1;
+  Slot& slot = *pool->slots[slot_id];
+  if (!slot.finished || line_idx < 0 || line_idx >= int(slot.result.lines.size()))
+    return -1;
+  const PvLine& line = slot.result.lines[line_idx];
+  *multipv = line.multipv;
+  *depth = line.depth;
+  *is_mate = line.mate ? 1 : 0;
+  *value = line.value;
+  // Render the PV by replaying from the root (castling notation etc.).
+  std::string out;
+  Position pos = slot.root;
+  for (Move m : line.pv) {
+    if (!out.empty()) out += ' ';
+    out += pos.uci(m);
+    pos.make(m);
+  }
+  return copy_str(out, pv, pvlen);
+}
+
+void fc_pool_release(SearchPool* pool, int slot_id) {
+  if (slot_id >= 0 && slot_id < int(pool->slots.size())) {
+    Slot& slot = *pool->slots[slot_id];
+    slot.active = false;
+    slot.finished = false;
+    slot.result = SearchResult();
+  }
+}
+
+}  // extern "C"
+}  // namespace fc

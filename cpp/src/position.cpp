@@ -574,6 +574,16 @@ void Position::make(Move m) {
   }
 }
 
+void Position::make_null() {
+  if (ep_square != SQ_NONE) {
+    hash ^= zobrist::ep_file[file_of(ep_square)];
+    ep_square = SQ_NONE;
+  }
+  stm = ~stm;
+  hash ^= zobrist::black_to_move;
+  halfmove++;
+}
+
 // ---------------------------------------------------------------------------
 // UCI
 // ---------------------------------------------------------------------------

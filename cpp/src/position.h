@@ -82,6 +82,10 @@ struct Position {
   void legal_moves(MoveList& out) const;
   void make(Move m);
 
+  // Null move (pass), for null-move pruning in search. Keeps hash/ep
+  // bookkeeping consistent; not a legal chess move.
+  void make_null();
+
   std::string uci(Move m) const;
   // Parse a UCI move against this position. Accepts both Chess960
   // (king-takes-rook, e1h1) and standard (e1g1) castling notation, like

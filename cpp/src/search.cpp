@@ -1,0 +1,414 @@
+#include "search.h"
+
+#include <algorithm>
+#include <cstring>
+
+namespace fc {
+
+// ---------------------------------------------------------------------------
+// Transposition table
+// ---------------------------------------------------------------------------
+
+namespace {
+constexpr int16_t EVAL_NONE = TT_EVAL_NONE;
+
+size_t floor_pow2(size_t n) {
+  size_t p = 1;
+  while (p * 2 <= n) p *= 2;
+  return p;
+}
+}  // namespace
+
+TranspositionTable::TranspositionTable(size_t bytes) {
+  size_t count = floor_pow2(std::max<size_t>(1024, bytes / sizeof(TTEntry)));
+  entries_.resize(count);
+  mask_ = count - 1;
+}
+
+TTEntry* TranspositionTable::probe(uint64_t key, bool& hit) {
+  TTEntry* e = &entries_[key & mask_];
+  // An entry counts as a hit if it carries either a search bound or a
+  // cached static eval for this key.
+  hit = e->key == key && (e->bound != TT_NONE || e->eval != TT_EVAL_NONE);
+  return e;
+}
+
+void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
+                               int depth, TTBound bound) {
+  TTEntry* e = &entries_[key & mask_];
+  // Depth-preferred within a generation; always replace stale entries.
+  if (e->bound == TT_NONE || e->gen != gen_ || e->key != key ||
+      depth >= e->depth || bound == TT_EXACT) {
+    if (e->key == key) {
+      if (move == MOVE_NONE) move = e->move;  // keep old best move
+      if (eval == TT_EVAL_NONE) eval = e->eval;  // keep cached static eval
+    }
+    e->key = key;
+    e->move = move;
+    e->value = int16_t(value);
+    e->eval = int16_t(eval);
+    e->depth = uint8_t(std::max(0, depth));
+    e->bound = bound;
+    e->gen = gen_;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Value conversion
+// ---------------------------------------------------------------------------
+
+void value_to_uci(int value, bool& mate, int& out) {
+  if (value >= VALUE_MATE_IN_MAX) {
+    mate = true;
+    out = (VALUE_MATE - value + 1) / 2;
+  } else if (value <= -VALUE_MATE_IN_MAX) {
+    mate = true;
+    out = -((VALUE_MATE + value) / 2);
+  } else {
+    mate = false;
+    out = value;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Search
+// ---------------------------------------------------------------------------
+
+int Search::evaluate(const Position& pos) {
+  // Clamp into the non-mate score range: keeps TT int16 storage exact,
+  // avoids the TT_EVAL_NONE sentinel, and prevents huge (e.g. random-net)
+  // evals from masquerading as mate scores.
+  int v = eval_->evaluate(pos);
+  constexpr int LIMIT = VALUE_MATE_IN_MAX - 1;
+  return v < -LIMIT ? -LIMIT : (v > LIMIT ? LIMIT : v);
+}
+
+// Mate scores are stored in the TT relative to the entry's node (plies
+// from there), not the root; convert on the way in/out.
+static int value_to_tt(int v, int ply) {
+  if (v >= VALUE_MATE_IN_MAX) return v + ply;
+  if (v <= -VALUE_MATE_IN_MAX) return v - ply;
+  return v;
+}
+
+static int value_from_tt(int v, int ply) {
+  if (v >= VALUE_MATE_IN_MAX) return v - ply;
+  if (v <= -VALUE_MATE_IN_MAX) return v + ply;
+  return v;
+}
+
+bool Search::is_repetition_or_50(const Position& pos, int) const {
+  if (pos.halfmove >= 100) {
+    // Rule-50 draw unless the position is checkmate right now (mate on
+    // the 100th halfmove takes precedence).
+    if (!pos.in_check()) return true;
+    MoveList evasions;
+    pos.legal_moves(evasions);
+    return evasions.size > 0;
+  }
+  // Twofold repetition anywhere along game + search path counts as draw
+  // (standard engine behavior). Scan is bounded by the halfmove clock.
+  int limit = int(path_.size()) - 1;
+  int span = std::min(limit, pos.halfmove);
+  for (int i = 2; i <= span; i += 2)
+    if (path_[limit - i] == pos.hash) return true;
+  return false;
+}
+
+// Move-ordering scores (higher = earlier).
+void Search::order_moves(const Position& pos, MoveList& moves, Move tt_move,
+                         int ply) {
+  int scores[MAX_MOVES];
+  for (int i = 0; i < moves.size; i++) {
+    Move m = moves.moves[i];
+    int score = 0;
+    if (m == tt_move) {
+      score = 1 << 30;
+    } else if (!pos.empty(move_to(m)) || move_kind(m) == MK_EN_PASSANT) {
+      int victim = move_kind(m) == MK_EN_PASSANT
+                       ? PAWN
+                       : piece_type(pos.piece_on(move_to(m)));
+      int attacker = move_kind(m) == MK_DROP ? PAWN : piece_type(pos.piece_on(move_from(m)));
+      score = (1 << 20) + victim * 16 - attacker;
+    } else if (move_promo(m) == QUEEN) {
+      score = (1 << 19);
+    } else if (ply < MAX_PLY &&
+               (m == killers_[ply][0] || m == killers_[ply][1])) {
+      score = 1 << 16;
+    } else {
+      Color us = pos.stm;
+      score = history_[us][move_from(m)][move_to(m)];
+    }
+    scores[i] = score;
+  }
+  // Insertion sort (lists are short and mostly sorted after the first few).
+  for (int i = 1; i < moves.size; i++) {
+    Move m = moves.moves[i];
+    int s = scores[i];
+    int j = i - 1;
+    while (j >= 0 && scores[j] < s) {
+      moves.moves[j + 1] = moves.moves[j];
+      scores[j + 1] = scores[j];
+      j--;
+    }
+    moves.moves[j + 1] = m;
+    scores[j + 1] = s;
+  }
+}
+
+int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
+  nodes_++;
+  if (allow_stop_ &&
+      ((node_limit_ && nodes_ >= node_limit_) || (external_stop_ && *external_stop_)))
+    stopped_ = true;
+  if (stopped_ || ply >= MAX_PLY) return evaluate(pos);
+
+  bool in_check = pos.in_check();
+  int best = -VALUE_INF;
+
+  if (!in_check) {
+    // Stand pat, with the TT's cached static eval when available.
+    bool hit;
+    TTEntry* tte = tt_->probe(pos.hash, hit);
+    int stand;
+    if (hit && tte->eval != EVAL_NONE) {
+      stand = tte->eval;
+    } else {
+      stand = evaluate(pos);
+      if (!hit) tt_->store(pos.hash, MOVE_NONE, 0, stand, 0, TT_NONE);
+      else tte->eval = int16_t(stand);
+    }
+    if (stand >= beta) return stand;
+    if (stand > alpha) alpha = stand;
+    best = stand;
+  }
+
+  MoveList moves;
+  pos.legal_moves(moves);
+  if (moves.size == 0) return in_check ? -(VALUE_MATE - ply) : VALUE_DRAW;
+
+  // In check: search every evasion. Otherwise captures/promotions only.
+  MoveList targets;
+  if (in_check) {
+    targets = moves;
+  } else {
+    for (Move m : moves)
+      if (!pos.empty(move_to(m)) || move_kind(m) == MK_EN_PASSANT ||
+          move_promo(m) == QUEEN)
+        targets.push(m);
+  }
+  order_moves(pos, targets, MOVE_NONE, ply);
+
+  for (Move m : targets) {
+    Position copy = pos;
+    copy.make(m);
+    path_.push_back(copy.hash);
+    int value = -qsearch(copy, -beta, -alpha, ply + 1);
+    path_.pop_back();
+    if (stopped_) return best > -VALUE_INF ? best : 0;
+    if (value > best) {
+      best = value;
+      if (value > alpha) {
+        alpha = value;
+        if (alpha >= beta) break;
+      }
+    }
+  }
+  return best;
+}
+
+int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
+                       int ply, bool is_pv) {
+  if (is_pv && ply < MAX_PLY) pv_len_[ply] = 0;
+
+  if (ply > 0 && is_repetition_or_50(pos, ply)) return VALUE_DRAW;
+  if (ply >= MAX_PLY) return evaluate(pos);
+
+  bool in_check = pos.in_check();
+  if (in_check) depth++;  // check extension
+
+  if (depth <= 0) return qsearch(pos, alpha, beta, ply);
+
+  nodes_++;
+  if (allow_stop_ &&
+      ((node_limit_ && nodes_ >= node_limit_) || (external_stop_ && *external_stop_)))
+    stopped_ = true;
+  if (stopped_) return 0;
+
+  const int alpha_orig = alpha;
+
+  // Mate-distance pruning.
+  alpha = std::max(alpha, -(VALUE_MATE - ply));
+  beta = std::min(beta, VALUE_MATE - (ply + 1));
+  if (alpha >= beta) return alpha;
+
+  bool hit;
+  TTEntry* tte = tt_->probe(pos.hash, hit);
+  Move tt_move = hit ? tte->move : MOVE_NONE;
+  if (hit && !is_pv && ply > 0 && tte->depth >= depth && tte->bound != TT_NONE) {
+    int v = value_from_tt(tte->value, ply);
+    if ((tte->bound == TT_EXACT) ||
+        (tte->bound == TT_LOWER && v >= beta) ||
+        (tte->bound == TT_UPPER && v <= alpha))
+      return v;
+  }
+
+  // Null-move pruning: skip a turn; if we still beat beta at reduced
+  // depth, the node is almost certainly a fail-high. Requires non-pawn
+  // material to avoid zugzwang traps.
+  if (!is_pv && !in_check && depth >= 3 && ply > 0 &&
+      (pos.pieces(pos.stm) & ~(pos.pieces(pos.stm, PAWN) | pos.pieces(pos.stm, KING)))) {
+    Position copy = pos;
+    copy.make_null();
+    path_.push_back(copy.hash);
+    int v = -alpha_beta(copy, -beta, -beta + 1, depth - 3, ply + 1, false);
+    path_.pop_back();
+    if (stopped_) return 0;
+    if (v >= beta && v < VALUE_MATE_IN_MAX) return v;
+  }
+
+  MoveList moves;
+  pos.legal_moves(moves);
+  if (moves.size == 0) return in_check ? -(VALUE_MATE - ply) : VALUE_DRAW;
+
+  order_moves(pos, moves, tt_move, ply);
+
+  Move best_move = MOVE_NONE;
+  int best = -VALUE_INF;
+  int move_count = 0;
+
+  for (Move m : moves) {
+    if (ply == 0 &&
+        std::find(excluded_root_moves_.begin(), excluded_root_moves_.end(), m) !=
+            excluded_root_moves_.end())
+      continue;
+    move_count++;
+
+    Position copy = pos;
+    copy.make(m);
+    path_.push_back(copy.hash);
+
+    int value;
+    if (move_count == 1) {
+      value = -alpha_beta(copy, -beta, -alpha, depth - 1, ply + 1, is_pv);
+    } else {
+      // Late-move reduction for quiet late moves, then PVS re-searches.
+      int reduction = 0;
+      if (depth >= 3 && move_count > 4 && pos.empty(move_to(m)) &&
+          move_promo(m) == NO_PIECE_TYPE && !in_check)
+        reduction = 1 + (move_count > 12);
+      value = -alpha_beta(copy, -alpha - 1, -alpha, depth - 1 - reduction,
+                          ply + 1, false);
+      if (value > alpha && reduction > 0)
+        value = -alpha_beta(copy, -alpha - 1, -alpha, depth - 1, ply + 1, false);
+      if (value > alpha && value < beta)
+        value = -alpha_beta(copy, -beta, -alpha, depth - 1, ply + 1, is_pv);
+    }
+    path_.pop_back();
+    if (stopped_ && best > -VALUE_INF) break;
+    if (stopped_) return 0;
+
+    if (value > best) {
+      best = value;
+      best_move = m;
+      if (value > alpha) {
+        alpha = value;
+        if (is_pv && ply + 1 < MAX_PLY) {
+          pv_table_[ply][0] = m;
+          memcpy(&pv_table_[ply][1], &pv_table_[ply + 1][0],
+                 sizeof(Move) * pv_len_[ply + 1]);
+          pv_len_[ply] = pv_len_[ply + 1] + 1;
+        }
+        if (alpha >= beta) {
+          // Killer/history bookkeeping for quiet cutoffs.
+          if (pos.empty(move_to(m)) && move_kind(m) != MK_EN_PASSANT) {
+            if (killers_[ply][0] != m) {
+              killers_[ply][1] = killers_[ply][0];
+              killers_[ply][0] = m;
+            }
+            history_[pos.stm][move_from(m)][move_to(m)] += depth * depth;
+          }
+          break;
+        }
+      }
+    }
+  }
+
+  if (move_count == 0) return VALUE_DRAW;  // all root moves excluded
+
+  if (!stopped_) {
+    TTBound bound = best >= beta    ? TT_LOWER
+                    : best > alpha_orig ? TT_EXACT
+                                        : TT_UPPER;
+    tt_->store(pos.hash, best_move, value_to_tt(best, ply), EVAL_NONE, depth, bound);
+  }
+
+  return best;
+}
+
+SearchResult Search::run(const Position& root,
+                         const std::vector<uint64_t>& game_history,
+                         const SearchLimits& limits) {
+  SearchResult result;
+  nodes_ = 0;
+  node_limit_ = limits.nodes;
+  stopped_ = false;
+  allow_stop_ = false;
+  external_stop_ = limits.stop;
+  path_ = game_history;
+  if (path_.empty() || path_.back() != root.hash) path_.push_back(root.hash);
+  root_history_len_ = path_.size();
+  memset(killers_, 0xFF, sizeof(killers_));
+  memset(history_, 0, sizeof(history_));
+  tt_->new_generation();
+
+  MoveList root_moves;
+  root.legal_moves(root_moves);
+  if (root_moves.size == 0) {
+    // Terminal root: report like a finished engine would (depth 0,
+    // mate 0 when checkmated, cp 0 when stalemated; protocol.md:99-104).
+    PvLine line;
+    line.depth = 0;
+    line.mate = root.in_check();
+    line.value = 0;
+    result.lines.push_back(line);
+    result.nodes = 0;
+    return result;
+  }
+
+  int max_depth = limits.depth > 0 ? std::min(limits.depth, MAX_PLY - 1) : MAX_PLY - 1;
+  int multipv = std::min<int>(std::max(1, limits.multipv), root_moves.size);
+
+  Move overall_best = MOVE_NONE;
+
+  for (int depth = 1; depth <= max_depth && !stopped_; depth++) {
+    std::vector<Move> excluded;
+    for (int rank = 1; rank <= multipv; rank++) {
+      excluded_root_moves_ = excluded;
+      int value = alpha_beta(root, -VALUE_INF, VALUE_INF, depth, 0, true);
+      if (stopped_ || pv_len_[0] == 0) break;  // discard interrupted search
+      PvLine line;
+      line.multipv = rank;
+      line.depth = depth;
+      value_to_uci(value, line.mate, line.value);
+      line.pv.assign(&pv_table_[0][0], &pv_table_[0][0] + pv_len_[0]);
+      result.lines.push_back(line);
+      excluded.push_back(line.pv[0]);
+      if (rank == 1) {
+        overall_best = line.pv[0];
+        result.depth = depth;
+      }
+    }
+    // At least one full iteration is in the bag; the node budget may now
+    // interrupt freely.
+    allow_stop_ = true;
+    if (node_limit_ && nodes_ >= node_limit_) break;
+    if (external_stop_ && *external_stop_) break;
+  }
+
+  result.best_move = overall_best;
+  result.nodes = nodes_;
+  return result;
+}
+
+}  // namespace fc

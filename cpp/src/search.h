@@ -1,0 +1,142 @@
+// Alpha-beta search with batched leaf evaluation.
+//
+// Feature set targets PV/score parity-grade output for the fishnet
+// protocol (SURVEY.md §7 step 4): iterative deepening, shared
+// transposition table, quiescence search, MultiPV, node budgets, mate
+// scores, repetition/50-move draws. The evaluation is *external*: at
+// each leaf the search calls EvalBridge::evaluate(), which may suspend
+// the calling fiber until a TPU microbatch returns (pool.cpp), or answer
+// immediately from the scalar C++ NNUE (CPU fallback / oracle tests).
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "nnue.h"
+#include "position.h"
+
+namespace fc {
+
+constexpr int VALUE_MATE = 32000;
+constexpr int VALUE_INF = 32500;
+constexpr int MAX_PLY = 128;
+constexpr int VALUE_MATE_IN_MAX = VALUE_MATE - MAX_PLY;
+constexpr int VALUE_DRAW = 0;
+
+// Centipawn eval provider. Implementations: scalar NNUE (immediate) or
+// the fiber pool's batching bridge (suspends).
+class EvalBridge {
+ public:
+  virtual ~EvalBridge() = default;
+  // Static eval of pos from the side to move's point of view.
+  virtual int evaluate(const Position& pos) = 0;
+};
+
+class ScalarEval : public EvalBridge {
+ public:
+  explicit ScalarEval(const NnueNet* net) : net_(net) {}
+  int evaluate(const Position& pos) override { return nnue_evaluate(*net_, pos); }
+
+ private:
+  const NnueNet* net_;
+};
+
+// -- transposition table (shared across all searches; the scheduler is
+// single-threaded so no synchronization is needed) ------------------------
+
+enum TTBound : uint8_t { TT_NONE = 0, TT_UPPER = 1, TT_LOWER = 2, TT_EXACT = 3 };
+
+// Sentinel for "no cached static eval" in a TT entry.
+constexpr int16_t TT_EVAL_NONE = 32001;
+
+struct TTEntry {
+  uint64_t key = 0;
+  Move move = MOVE_NONE;
+  int16_t value = 0;
+  int16_t eval = TT_EVAL_NONE;
+  uint8_t depth = 0;
+  uint8_t bound = TT_NONE;
+  uint16_t gen = 0;
+};
+
+class TranspositionTable {
+ public:
+  explicit TranspositionTable(size_t bytes = 256ull << 20);
+  TTEntry* probe(uint64_t key, bool& hit);
+  void store(uint64_t key, Move move, int value, int eval, int depth, TTBound bound);
+  void new_generation() { gen_++; }
+
+ private:
+  std::vector<TTEntry> entries_;
+  size_t mask_;
+  uint16_t gen_ = 0;
+};
+
+// -- search ---------------------------------------------------------------
+
+struct SearchLimits {
+  uint64_t nodes = 0;  // 0 = unlimited
+  int depth = 0;       // 0 = unlimited (MAX_PLY)
+  int multipv = 1;
+  // External stop request (e.g. movetime watchdog); polled per node.
+  // The first depth-1 iteration still completes.
+  const bool* stop = nullptr;
+};
+
+struct PvLine {
+  int multipv = 1;  // 1-based rank
+  int depth = 0;
+  bool mate = false;
+  int value = 0;  // cp, or mate distance in moves (signed) when mate
+  std::vector<Move> pv;
+};
+
+struct SearchResult {
+  std::vector<PvLine> lines;  // one entry per (iteration, multipv rank)
+  Move best_move = MOVE_NONE;
+  int depth = 0;
+  uint64_t nodes = 0;
+};
+
+class Search {
+ public:
+  Search(TranspositionTable* tt, EvalBridge* eval) : tt_(tt), eval_(eval) {}
+
+  // Run a full iterative-deepening search. game_history: Zobrist hashes
+  // of positions before root (for repetition detection), most recent last.
+  SearchResult run(const Position& root, const std::vector<uint64_t>& game_history,
+                   const SearchLimits& limits);
+
+ private:
+  int alpha_beta(const Position& pos, int alpha, int beta, int depth, int ply,
+                 bool is_pv);
+  int qsearch(const Position& pos, int alpha, int beta, int ply);
+  int evaluate(const Position& pos);
+  bool is_repetition_or_50(const Position& pos, int ply) const;
+  void order_moves(const Position& pos, MoveList& moves, Move tt_move, int ply);
+
+  TranspositionTable* tt_;
+  EvalBridge* eval_;
+  uint64_t nodes_ = 0;
+  uint64_t node_limit_ = 0;
+  bool stopped_ = false;
+  // The first depth-1 iteration always completes so every search yields
+  // at least one scored line, whatever the node budget.
+  bool allow_stop_ = false;
+  const bool* external_stop_ = nullptr;
+  std::vector<uint64_t> path_;  // hashes from game start through search path
+  size_t root_history_len_ = 0;
+  Move killers_[MAX_PLY][2];
+  int history_[COLOR_NB][64][64];
+  Move pv_table_[MAX_PLY][MAX_PLY];
+  int pv_len_[MAX_PLY];
+  std::vector<Move> excluded_root_moves_;  // for MultiPV iteration
+};
+
+// Convert an internal value to (is_mate, value-for-uci): mate distance in
+// moves from the root's side to move, or centipawns.
+void value_to_uci(int value, bool& mate, int& out);
+
+}  // namespace fc

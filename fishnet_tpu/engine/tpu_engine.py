@@ -1,0 +1,94 @@
+"""The tpu-nnue engine: the reference's `--engine` seam filled with the
+batched search service.
+
+Where the reference's worker drives a Stockfish subprocess over UCI
+(src/stockfish.rs:235-344), this engine submits the position into the
+shared SearchService; its alpha-beta runs as a fiber whose leaf evals are
+batched with every other in-flight search onto the TPU. All `go`
+parameters follow the reference's mapping (src/stockfish.rs:286-344):
+analysis -> node budget per eval flavor (+ optional depth), play ->
+movetime/depth by skill level.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fishnet_tpu.engine.base import Engine, EngineFactory, EngineError
+from fishnet_tpu.ipc import Position, PositionResponse
+from fishnet_tpu.protocol.types import EngineFlavor, Matrix, Score
+from fishnet_tpu.search.service import SearchResultData, SearchService
+
+
+def result_to_response(position: Position, result: SearchResultData) -> PositionResponse:
+    scores = Matrix()
+    pvs = Matrix()
+    for line in result.lines:
+        score = Score.mate(line.value) if line.is_mate else Score.cp(line.value)
+        scores.set(line.multipv, line.depth, score)
+        pvs.set(line.multipv, line.depth, line.pv)
+    if scores.best() is None:
+        raise EngineError("search returned no score")
+    nps = int(result.nodes / result.time_seconds) if result.time_seconds > 0 else None
+    return PositionResponse(
+        work=position.work,
+        position_id=position.position_id,
+        scores=scores,
+        pvs=pvs,
+        best_move=result.best_move,
+        depth=result.depth,
+        nodes=result.nodes,
+        time_seconds=result.time_seconds,
+        nps=nps,
+        url=position.url,
+    )
+
+
+class TpuNnueEngine(Engine):
+    """A lightweight handle; all instances share one SearchService, which
+    is the whole point — leaves from every worker land in one batch."""
+
+    def __init__(self, service: SearchService, flavor: EngineFlavor) -> None:
+        self.service = service
+        self.flavor = flavor
+
+    async def go(self, position: Position) -> PositionResponse:
+        work = position.work
+        if work.is_analysis:
+            nodes = work.nodes.get(position.flavor.eval_flavor())
+            depth = work.depth or 0
+            multipv = work.effective_multipv()
+            movetime = None
+        else:
+            level = work.level
+            nodes = 0
+            depth = level.depth()
+            multipv = 1
+            movetime = level.movetime_ms() / 1000.0
+
+        try:
+            result = await self.service.search(
+                root_fen=position.root_fen,
+                moves=position.moves,
+                nodes=nodes,
+                depth=depth,
+                multipv=multipv,
+                movetime_seconds=movetime,
+            )
+        except EngineError:
+            raise
+        except Exception as err:  # noqa: BLE001 - native/service failure
+            raise EngineError(f"search service failed: {err!r}") from err
+        return result_to_response(position, result)
+
+    async def close(self) -> None:
+        # The service is shared and outlives individual engine handles.
+        return None
+
+
+class TpuNnueEngineFactory(EngineFactory):
+    def __init__(self, service: SearchService) -> None:
+        self.service = service
+
+    async def create(self, flavor: EngineFlavor) -> Engine:
+        return TpuNnueEngine(self.service, flavor)

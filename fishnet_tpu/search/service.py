@@ -1,0 +1,374 @@
+"""SearchService: the bridge between asyncio workers and the native
+fiber pool + JAX evaluator.
+
+Topology (SURVEY.md §7): every worker's ``go(position)`` submits a search
+into one shared native pool. A single driver thread runs the pool's
+step/evaluate/provide cycle: `fc_pool_step` advances all search fibers to
+their next leaf evaluations, the pending leaves are evaluated as ONE
+JAX/TPU microbatch, `fc_pool_provide` wakes the fibers. Search results
+resolve asyncio futures back on the event loop.
+
+ctypes calls release the GIL, so fiber execution (C++) and the TPU
+dispatch overlap with the event loop's HTTP work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from fishnet_tpu.chess.core import NativeCoreError, load
+from fishnet_tpu.nnue import spec
+from fishnet_tpu.nnue.weights import NnueWeights
+
+
+@dataclass
+class PvLineData:
+    multipv: int
+    depth: int
+    is_mate: bool
+    value: int
+    pv: List[str]
+
+
+@dataclass
+class SearchResultData:
+    lines: List[PvLineData]
+    best_move: Optional[str]
+    depth: int
+    nodes: int
+    time_seconds: float
+
+
+@dataclass
+class _Pending:
+    future: asyncio.Future
+    loop: asyncio.AbstractEventLoop
+    started: float
+
+
+def _bind_pool_api(lib: ctypes.CDLL) -> None:
+    if getattr(lib, "_pool_bound", False):
+        return
+    lib.fc_pool_new.argtypes = [ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p]
+    lib.fc_pool_new.restype = ctypes.c_void_p
+    lib.fc_pool_free.argtypes = [ctypes.c_void_p]
+    lib.fc_pool_submit.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.fc_pool_submit.restype = ctypes.c_int
+    lib.fc_pool_stop.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.fc_pool_step.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+    ]
+    lib.fc_pool_step.restype = ctypes.c_int
+    lib.fc_pool_provide.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+    ]
+    lib.fc_pool_active.argtypes = [ctypes.c_void_p]
+    lib.fc_pool_active.restype = ctypes.c_int
+    lib.fc_pool_next_finished.argtypes = [ctypes.c_void_p]
+    lib.fc_pool_next_finished.restype = ctypes.c_int
+    lib.fc_pool_result_summary.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.fc_pool_result_summary.restype = ctypes.c_int
+    lib.fc_pool_result_line.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.fc_pool_result_line.restype = ctypes.c_int
+    lib.fc_pool_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib._pool_bound = True
+
+
+class SearchService:
+    """Shared batched-search backend. One instance per client process."""
+
+    def __init__(
+        self,
+        weights: Optional[NnueWeights] = None,
+        net_path: Optional[Union[str, Path]] = None,
+        pool_slots: int = 256,
+        batch_capacity: int = 256,
+        tt_bytes: int = 64 << 20,
+        backend: str = "jax",  # "jax" | "scalar"
+    ) -> None:
+        self._lib = load()
+        _bind_pool_api(self._lib)
+
+        if weights is None and net_path is None:
+            raise ValueError("need weights or net_path")
+        if net_path is None:
+            import tempfile
+
+            self._tmp = tempfile.NamedTemporaryFile(suffix=".nnue", delete=False)
+            weights.save(self._tmp.name)
+            net_path = self._tmp.name
+        self.net_path = str(net_path)
+        self.backend = backend
+        self.batch_capacity = batch_capacity
+
+        # The scalar net is always loaded into the pool: it serves the
+        # "scalar" backend and is the fallback if JAX is unusable.
+        self._pool = self._lib.fc_pool_new(
+            pool_slots, tt_bytes, self.net_path.encode()
+        )
+        if not self._pool:
+            raise NativeCoreError("failed to create search pool")
+
+        self._params = None
+        self._eval_fn = None
+        if backend == "jax":
+            import jax
+
+            from fishnet_tpu.nnue.jax_eval import evaluate_batch_jit, params_from_weights
+
+            w = weights if weights is not None else NnueWeights.load(net_path)
+            self._params = jax.device_put(params_from_weights(w))
+            self._eval_fn = evaluate_batch_jit
+
+        # Driver state. Buffers must exist before the thread starts.
+        cap = batch_capacity
+        self._feat_buf = np.empty((cap, 2, spec.MAX_ACTIVE_FEATURES), dtype=np.int32)
+        self._bucket_buf = np.empty((cap,), dtype=np.int32)
+        self._slot_buf = np.empty((cap,), dtype=np.int32)
+        self._pending: Dict[int, _Pending] = {}
+        self._submissions: List[Tuple] = []
+        self._stop_requests: List[Tuple[int, _Pending]] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stopping = False
+        self._thread = threading.Thread(target=self._drive, name="search-driver", daemon=True)
+        self._thread.start()
+
+    # -- public API -------------------------------------------------------
+
+    async def search(
+        self,
+        root_fen: str,
+        moves: List[str],
+        nodes: int = 0,
+        depth: int = 0,
+        multipv: int = 1,
+        movetime_seconds: Optional[float] = None,
+    ) -> SearchResultData:
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        with self._lock:
+            if self._stopping:
+                raise NativeCoreError("search service is shut down")
+            self._submissions.append(
+                (root_fen, " ".join(moves), nodes, depth, multipv, future, loop,
+                 movetime_seconds)
+            )
+        self._wake.set()
+        return await future
+
+    def _maybe_stop(self, slot: int, pending: _Pending) -> None:
+        """Movetime watchdog (event-loop thread): hand the stop request to
+        the driver thread, which owns the pool and the slot mapping —
+        avoids a cross-thread write and the slot-reuse TOCTOU."""
+        with self._lock:
+            self._stop_requests.append((slot, pending))
+        self._wake.set()
+
+    def close(self) -> None:
+        self._stopping = True
+        self._wake.set()
+        self._thread.join(timeout=60)
+        if self._thread.is_alive():
+            # Driver stuck (e.g. inside a long XLA compile): leak the pool
+            # rather than freeing memory the thread still dereferences.
+            return
+        if self._pool:
+            self._lib.fc_pool_free(self._pool)
+            self._pool = None
+        tmp = getattr(self, "_tmp", None)
+        if tmp is not None:
+            import os
+
+            try:
+                os.unlink(tmp.name)
+            except OSError:
+                pass
+            self._tmp = None
+
+    # -- evaluation -------------------------------------------------------
+
+    def _evaluate(self, n: int) -> np.ndarray:
+        feats = self._feat_buf
+        buckets = self._bucket_buf
+        if self._eval_fn is not None:
+            # Fixed-shape batch (padded) so XLA compiles exactly once.
+            values = np.asarray(self._eval_fn(self._params, feats, buckets))
+            return values[:n].astype(np.int32)
+        raise NativeCoreError("no evaluator")  # pragma: no cover
+
+    # -- driver thread ----------------------------------------------------
+
+    def _drive(self) -> None:
+        try:
+            self._drive_inner()
+        except Exception as err:  # noqa: BLE001 - driver must not die silently
+            self._fail_all(NativeCoreError(f"search driver crashed: {err!r}"))
+            self._stopping = True
+            raise
+
+    def _drive_inner(self) -> None:
+        lib = self._lib
+        cap = self.batch_capacity
+        feat_ptr = self._feat_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        bucket_ptr = self._bucket_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        slot_ptr = self._slot_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+        while True:
+            if self._stopping:
+                self._fail_all(NativeCoreError("service shut down"))
+                return
+
+            # Apply movetime-watchdog stops (driver thread owns the pool).
+            with self._lock:
+                stop_requests, self._stop_requests = self._stop_requests, []
+            for slot, pending in stop_requests:
+                if self._pending.get(slot) is pending:
+                    lib.fc_pool_stop(self._pool, slot)
+
+            # Drain submissions into pool slots.
+            with self._lock:
+                submissions, self._submissions = self._submissions, []
+            for item in submissions:
+                fen, moves, nodes, depth, multipv, future, loop, movetime = item
+                use_scalar = 1 if self.backend == "scalar" else 0
+                slot = lib.fc_pool_submit(
+                    self._pool, fen.encode(), moves.encode(),
+                    nodes, depth, multipv, use_scalar,
+                )
+                if slot == -1:
+                    # Pool momentarily full: requeue; a slot frees up once
+                    # a running search is harvested below.
+                    with self._lock:
+                        self._submissions.append(item)
+                    continue
+                if slot < 0:
+                    loop.call_soon_threadsafe(
+                        future.set_exception,
+                        NativeCoreError(f"submit failed ({slot})"),
+                    )
+                    continue
+                pending = _Pending(future, loop, time.monotonic())
+                self._pending[slot] = pending
+                if movetime is not None:
+                    loop.call_soon_threadsafe(
+                        loop.call_later, movetime, self._maybe_stop, slot, pending
+                    )
+
+            # Advance fibers to their leaves; fill the eval batch.
+            n = lib.fc_pool_step(self._pool, feat_ptr, bucket_ptr, slot_ptr, cap)
+            if n > 0:
+                # Pad the tail so stale indices can't go out of range.
+                self._feat_buf[n:] = spec.NUM_FEATURES
+                self._bucket_buf[n:] = 0
+                values = self._evaluate(n)
+                arr = np.ascontiguousarray(values, dtype=np.int32)
+                lib.fc_pool_provide(
+                    self._pool, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n
+                )
+
+            # Harvest finished searches.
+            while True:
+                slot = lib.fc_pool_next_finished(self._pool)
+                if slot < 0:
+                    break
+                self._finish_slot(slot)
+
+            if n == 0 and lib.fc_pool_active(self._pool) == 0:
+                with self._lock:
+                    idle = not self._submissions and not self._stopping
+                if idle:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+
+    def _finish_slot(self, slot: int) -> None:
+        lib = self._lib
+        nodes = ctypes.c_uint64()
+        depth = ctypes.c_int32()
+        nlines = ctypes.c_int32()
+        bm = ctypes.create_string_buffer(16)
+        rc = lib.fc_pool_result_summary(
+            self._pool, slot, ctypes.byref(nodes), ctypes.byref(depth),
+            bm, len(bm), ctypes.byref(nlines),
+        )
+        pending = self._pending.pop(slot, None)
+        if pending is None:
+            lib.fc_pool_release(self._pool, slot)
+            return
+        if rc < 0:
+            lib.fc_pool_release(self._pool, slot)
+            err = NativeCoreError("result extraction failed")
+            pending.loop.call_soon_threadsafe(_set_exc, pending.future, err)
+            return
+
+        lines: List[PvLineData] = []
+        pv_buf = ctypes.create_string_buffer(4096)
+        mpv = ctypes.c_int32()
+        ldepth = ctypes.c_int32()
+        is_mate = ctypes.c_int32()
+        value = ctypes.c_int32()
+        for i in range(nlines.value):
+            if (
+                lib.fc_pool_result_line(
+                    self._pool, slot, i, ctypes.byref(mpv), ctypes.byref(ldepth),
+                    ctypes.byref(is_mate), ctypes.byref(value), pv_buf, len(pv_buf),
+                )
+                < 0
+            ):
+                continue
+            pv = pv_buf.value.decode()
+            lines.append(
+                PvLineData(
+                    multipv=mpv.value,
+                    depth=ldepth.value,
+                    is_mate=bool(is_mate.value),
+                    value=value.value,
+                    pv=pv.split() if pv else [],
+                )
+            )
+        lib.fc_pool_release(self._pool, slot)
+        result = SearchResultData(
+            lines=lines,
+            best_move=bm.value.decode() or None,
+            depth=depth.value,
+            nodes=nodes.value,
+            time_seconds=max(1e-6, time.monotonic() - pending.started),
+        )
+        pending.loop.call_soon_threadsafe(_set_res, pending.future, result)
+
+    def _fail_all(self, err: Exception) -> None:
+        for pending in self._pending.values():
+            pending.loop.call_soon_threadsafe(_set_exc, pending.future, err)
+        self._pending.clear()
+
+
+def _set_res(future: asyncio.Future, value) -> None:
+    if not future.done():
+        future.set_result(value)
+
+
+def _set_exc(future: asyncio.Future, err: Exception) -> None:
+    if not future.done():
+        future.set_exception(err)

@@ -7,7 +7,18 @@ TPU hardware. This must be set before jax is first imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests must never claim the real TPU. The axon plugin registers its
+# backend factory at interpreter start (sitecustomize) and its hooks can
+# initialize the TPU tunnel even under JAX_PLATFORMS=cpu, so drop the
+# factory outright before any backend is initialized.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

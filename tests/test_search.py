@@ -1,0 +1,125 @@
+"""Search-core tests through the SearchService: mates, draws, budgets,
+MultiPV, and concurrent batched searches (JAX evaluator on CPU)."""
+
+import asyncio
+
+import pytest
+
+from fishnet_tpu.nnue.weights import NnueWeights
+from fishnet_tpu.search.service import SearchService
+
+pytestmark = pytest.mark.anyio
+
+BACKENDS = ["scalar", "jax"]
+
+
+@pytest.fixture(scope="module", params=BACKENDS)
+def service(request):
+    svc = SearchService(
+        weights=NnueWeights.random(seed=3),
+        pool_slots=64,
+        batch_capacity=64,
+        tt_bytes=16 << 20,
+        backend=request.param,
+    )
+    yield svc
+    svc.close()
+
+
+async def test_mate_in_one(service):
+    # Back-rank mate: Rd8#.
+    res = await service.search("6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1", [], depth=4)
+    assert res.best_move == "d1d8"
+    final = [l for l in res.lines if l.multipv == 1][-1]
+    assert final.is_mate and final.value == 1
+
+
+async def test_mated_root(service):
+    # Fool's mate final position: white is checkmated.
+    res = await service.search(
+        "rnb1kbnr/pppp1ppp/8/4p3/6Pq/5P2/PPPPP2P/RNBQKBNR w KQkq - 1 3", [], depth=3
+    )
+    assert res.best_move is None
+    assert res.lines[0].depth == 0
+    assert res.lines[0].is_mate and res.lines[0].value == 0
+    assert res.lines[0].pv == []
+
+
+async def test_stalemate_root(service):
+    res = await service.search("7k/5Q2/6K1/8/8/8/8/8 b - - 0 1", [], depth=3)
+    assert res.best_move is None
+    assert not res.lines[0].is_mate
+    assert res.lines[0].value == 0
+
+
+async def test_mate_in_two(service):
+    # A classic: 1.Qf7+? no — use a known forced mate-in-2 position.
+    # White: Kg1 Qg3 Rf1; Black: Kh8 pawn h7 g7. Qg3-b8? Use simpler:
+    # ladder mate. White Ra1 Rb2 vs Kh8: Rb2-b8 is check... h7 escape.
+    # Take a standard two-rook ladder: black king h8, rooks a7 b1.
+    res = await service.search("7k/R7/8/8/8/8/8/1R4K1 w - - 0 1", [], depth=3)
+    final = [l for l in res.lines if l.multipv == 1][-1]
+    assert final.is_mate and final.value <= 2
+    assert res.best_move == "b1b8"
+
+
+async def test_node_budget_respected(service):
+    res = await service.search(
+        "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R w KQkq - 4 4",
+        [], nodes=800,
+    )
+    # Depth-1 always completes; beyond that the budget binds (2x slack for
+    # the final iteration's overshoot before the first allow_stop check).
+    assert res.nodes <= 800 * 2
+    assert res.depth >= 1
+    assert res.best_move is not None
+
+
+async def test_history_repetition_draw(service):
+    # Same position reached before: searching it again on the same line
+    # must allow the engine to know repetition = draw; here we just check
+    # the search completes with history provided.
+    moves = "g1f3 g8f6 f3g1 f6g8 g1f3 g8f6 f3g1 f6g8".split()
+    res = await service.search(
+        "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+        moves, depth=2,
+    )
+    assert res.best_move is not None
+
+
+async def test_multipv_ranks(service):
+    res = await service.search(
+        "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R w KQkq - 4 4",
+        [], depth=3, multipv=3,
+    )
+    deepest = res.depth
+    finals = {l.multipv: l for l in res.lines if l.depth == deepest}
+    assert set(finals) == {1, 2, 3}
+    first_moves = {finals[r].pv[0] for r in (1, 2, 3)}
+    assert len(first_moves) == 3  # distinct root moves per rank
+
+
+async def test_concurrent_searches_batch(service):
+    fens = [
+        "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+        "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R w KQkq - 4 4",
+        "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
+        "rnbq1k1r/pp1Pbppp/2p5/8/2B5/8/PPP1NnPP/RNBQK2R w KQ - 1 8",
+    ] * 8
+    results = await asyncio.gather(
+        *[service.search(fen, [], nodes=500) for fen in fens]
+    )
+    assert len(results) == 32
+    for res in results:
+        assert res.best_move is not None
+        assert res.nodes > 0
+
+
+async def test_illegal_submit_rejected(service):
+    with pytest.raises(Exception):
+        await service.search("not a fen", [], depth=2)
+    with pytest.raises(Exception):
+        await service.search(
+            "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+            ["e2e5"], depth=2,
+        )

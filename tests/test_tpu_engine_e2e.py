@@ -1,0 +1,115 @@
+"""The full slice: fake lichess server -> client -> queue -> workers ->
+TpuNnueEngine -> batched fiber searches -> JAX NNUE eval -> submitted
+analysis. This is the reference's whole pipeline with the engine tier
+replaced by the batched TPU backend."""
+
+import asyncio
+
+import pytest
+
+from fishnet_tpu.client import Client
+from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
+from fishnet_tpu.nnue.weights import NnueWeights
+from fishnet_tpu.search.service import SearchService
+from fishnet_tpu.utils.logger import Logger
+from tests.fake_server import VALID_KEY, FakeServer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture(scope="module")
+def service():
+    svc = SearchService(
+        weights=NnueWeights.random(seed=11),
+        pool_slots=64,
+        batch_capacity=64,
+        tt_bytes=16 << 20,
+        backend="jax",
+    )
+    yield svc
+    svc.close()
+
+
+async def wait_for(predicate, timeout=60.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+async def test_analysis_with_real_engine(service):
+    async with FakeServer() as server:
+        moves = "e2e4 c7c5 g1f3 d7d6 d2d4 c5d4"
+        work_id = server.lichess.add_analysis_job(
+            moves=moves, skip_positions=[2], nodes=400
+        )
+        client = Client(
+            endpoint=server.endpoint,
+            key=VALID_KEY,
+            cores=4,
+            engine_factory=TpuNnueEngineFactory(service),
+            logger=Logger(),
+            max_backoff=0.2,
+        )
+        await client.start()
+        assert await wait_for(lambda: work_id in server.lichess.analyses)
+        await client.stop()
+
+        parts = server.lichess.analyses[work_id]["analysis"]
+        assert len(parts) == 7
+        assert parts[2] == {"skipped": True}
+        for i, part in enumerate(parts):
+            if i == 2:
+                continue
+            assert "score" in part and ("cp" in part["score"] or "mate" in part["score"])
+            assert part["depth"] >= 1
+            assert part["nodes"] >= 1
+            # Real engine: PV must be present and start with a legal move
+            # (4 chars minimum).
+            assert len(part.get("pv", "x" * 4)) >= 4
+
+
+async def test_move_job_with_real_engine(service):
+    async with FakeServer() as server:
+        work_id = server.lichess.add_move_job(moves="e2e4", level=3)
+        client = Client(
+            endpoint=server.endpoint,
+            key=VALID_KEY,
+            cores=2,
+            engine_factory=TpuNnueEngineFactory(service),
+            logger=Logger(),
+            max_backoff=0.2,
+        )
+        await client.start()
+        assert await wait_for(lambda: work_id in server.lichess.moves)
+        await client.stop()
+        best = server.lichess.moves[work_id]["move"]["bestmove"]
+        assert best is not None and len(best) >= 4
+
+
+async def test_mate_position_reported(service):
+    async with FakeServer() as server:
+        # Game ending in fool's mate: final ply is checkmate.
+        moves = "f2f3 e7e5 g2g4 d8h4"
+        work_id = server.lichess.add_analysis_job(moves=moves, nodes=300)
+        client = Client(
+            endpoint=server.endpoint,
+            key=VALID_KEY,
+            cores=2,
+            engine_factory=TpuNnueEngineFactory(service),
+            logger=Logger(),
+            max_backoff=0.2,
+        )
+        await client.start()
+        assert await wait_for(lambda: work_id in server.lichess.analyses)
+        await client.stop()
+        parts = server.lichess.analyses[work_id]["analysis"]
+        # Final position: white is checkmated -> depth 0, mate 0, no pv.
+        final = parts[-1]
+        assert final["score"] == {"mate": 0}
+        assert final["depth"] == 0
+        assert "pv" not in final
+        # The ply before must see mate in 1.
+        assert parts[-2]["score"] == {"mate": 1}
