@@ -93,7 +93,9 @@ struct SearchPool {
   std::vector<std::unique_ptr<Slot>> slots;
   std::vector<int> last_batch;   // slot ids of the last step()'s evals
   std::deque<int> finished_queue;
-  size_t fiber_stack = 256 * 1024;
+  // Worst case per fiber.h's sizing analysis (MAX_PLY frames + qsearch
+  // tail at ~2.5 KB/frame): needs the full 512 KB; pages commit lazily.
+  size_t fiber_stack = 512 * 1024;
 
   SearchPool(int max_slots, size_t tt_bytes) : tt(tt_bytes) {
     slots.resize(max_slots);
@@ -171,6 +173,13 @@ int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
   slot.wants_eval = false;
   slot.result = SearchResult();
   if (!slot.fiber) slot.fiber = std::make_unique<Fiber>(pool->fiber_stack);
+  if (!slot.fiber->valid()) {
+    // Stack mmap failed (memory pressure / map-count exhaustion): refuse
+    // the slot instead of crashing in makecontext later.
+    slot.fiber.reset();
+    slot.active = false;
+    return -4;
+  }
   if (!slot.bridge) slot.bridge = std::make_unique<BatchedEval>(&slot);
   return id;
 }

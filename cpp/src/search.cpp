@@ -202,9 +202,7 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
   for (Move m : targets) {
     Position copy = pos;
     copy.make(m);
-    path_.push_back(copy.hash);
     int value = -qsearch(copy, -beta, -alpha, ply + 1);
-    path_.pop_back();
     if (stopped_) return best > -VALUE_INF ? best : 0;
     if (value > best) {
       best = value;

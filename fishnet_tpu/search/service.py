@@ -188,7 +188,8 @@ class SearchService:
         self._wake.set()
 
     def close(self) -> None:
-        self._stopping = True
+        with self._lock:
+            self._stopping = True
         self._wake.set()
         self._thread.join(timeout=60)
         if self._thread.is_alive():
@@ -359,9 +360,17 @@ class SearchService:
         pending.loop.call_soon_threadsafe(_set_res, pending.future, result)
 
     def _fail_all(self, err: Exception) -> None:
+        """Resolve every outstanding future: in-flight searches AND
+        submissions still queued (or requeued after a pool-full submit)
+        that never reached a slot — otherwise their callers hang."""
         for pending in self._pending.values():
             pending.loop.call_soon_threadsafe(_set_exc, pending.future, err)
         self._pending.clear()
+        with self._lock:
+            submissions, self._submissions = self._submissions, []
+        for item in submissions:
+            future, loop = item[5], item[6]
+            loop.call_soon_threadsafe(_set_exc, future, err)
 
 
 def _set_res(future: asyncio.Future, value) -> None:
