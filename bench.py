@@ -1,0 +1,93 @@
+"""Headline benchmark: aggregate search throughput (nodes/s) with many
+concurrent analyses sharing one batched TPU evaluator.
+
+Mirrors the reference's production shape (SURVEY.md §6): a client works
+many analysis batches concurrently, each position searched under a fixed
+node budget. Here all searches are fibers in one native pool whose leaf
+evals run as single JAX microbatches on the TPU.
+
+Baseline: the reference's *top-end client* finishes an average batch
+(60 positions x 2 Mnodes) in <= 35 s (reference src/stats.rs:135-148),
+i.e. ~3.43 Mnodes/s aggregate on a whole multi-core machine. The
+north-star target is >= 20 Mnodes/s (BASELINE.json).
+
+Prints exactly one JSON line:
+  {"metric": "aggregate_search_nps", "value": N, "unit": "nodes/s",
+   "vs_baseline": N / 3.43e6}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+
+REFERENCE_BASELINE_NPS = 60 * 2_000_000 / 35.0  # top-end fishnet client
+
+CONCURRENT_SEARCHES = 64
+NODES_PER_SEARCH = 50_000
+WARMUP_SEARCHES = 4
+WARMUP_NODES = 2_000
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# A spread of real middlegame/endgame positions so searches differ.
+FENS = [
+    "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+    "r1bqkbnr/pppp1ppp/2n5/4p3/4P3/5N2/PPPP1PPP/RNBQKB1R w KQkq - 2 3",
+    "r1bqk2r/ppp2ppp/2np1n2/2b1p3/2B1P3/2PP1N2/PP3PPP/RNBQK2R w KQkq - 0 6",
+    "r2q1rk1/ppp2ppp/2npbn2/2b1p3/4P3/2PP1NN1/PPB2PPP/R1BQ1RK1 w - - 6 9",
+    "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
+    "4rrk1/pp1n3p/3q2pQ/2p1pb2/2PP4/2P3N1/P2B2PP/4RRK1 b - - 7 19",
+    "r3r1k1/2p2ppp/p1p1bn2/8/1q2P3/2NPQN2/PPP3PP/R4RK1 b - - 2 15",
+    "2rq1rk1/1p3ppp/p2p1n2/2bPp3/4P1b1/2N2N2/PPQ1BPPP/R1B2RK1 w - - 0 12",
+]
+
+
+async def run_searches(service, n: int, nodes: int) -> int:
+    tasks = [
+        service.search(root_fen=FENS[i % len(FENS)], moves=[], nodes=nodes, depth=0, multipv=1)
+        for i in range(n)
+    ]
+    results = await asyncio.gather(*tasks)
+    return sum(r.nodes for r in results)
+
+
+def main() -> None:
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.search.service import SearchService
+
+    log("bench: creating search service (jax backend)...")
+    weights = NnueWeights.random(seed=7)
+    service = SearchService(weights=weights, pool_slots=256, batch_capacity=256)
+    try:
+        log("bench: warmup (XLA compile)...")
+        asyncio.run(run_searches(service, WARMUP_SEARCHES, WARMUP_NODES))
+
+        log(f"bench: {CONCURRENT_SEARCHES} concurrent searches x {NODES_PER_SEARCH} nodes...")
+        start = time.perf_counter()
+        total_nodes = asyncio.run(run_searches(service, CONCURRENT_SEARCHES, NODES_PER_SEARCH))
+        elapsed = time.perf_counter() - start
+    finally:
+        service.close()
+
+    nps = total_nodes / elapsed
+    log(f"bench: {total_nodes} nodes in {elapsed:.2f}s")
+    print(
+        json.dumps(
+            {
+                "metric": "aggregate_search_nps",
+                "value": round(nps),
+                "unit": "nodes/s",
+                "vs_baseline": round(nps / REFERENCE_BASELINE_NPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Multi-chip parallelism: device meshes, shardings, and the sharded
+NNUE evaluator. See mesh.py for the design rationale."""
+
+from fishnet_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    ShardedEvaluator,
+    batch_sharding,
+    factor_mesh,
+    make_mesh,
+    replicated,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "ShardedEvaluator",
+    "batch_sharding",
+    "factor_mesh",
+    "make_mesh",
+    "replicated",
+]

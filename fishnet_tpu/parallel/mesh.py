@@ -1,0 +1,109 @@
+"""Device-mesh construction and sharded batched evaluation.
+
+The reference has no multi-device tier at all — its "distributed backend"
+is one HTTPS client (SURVEY.md §5, reference src/api.rs:489-536) and its
+intra-client parallelism is one engine subprocess per core. The TPU-native
+equivalent introduced here sits *below* the engine seam: NNUE microbatches
+are sharded across a ``jax.sharding.Mesh`` so the evaluator scales over
+ICI instead of over processes.
+
+Axes:
+
+* ``data``  — batch dimension of eval/training microbatches (dp).
+* ``model`` — the feature-transformer width L1 and the contracting
+  dimension of the first dense layer (tp). The FT table is the only
+  big tensor (22528 x 1024 int16), so this is where sharding pays.
+
+All collectives are inserted by XLA/GSPMD from sharding annotations —
+there are no hand-written collectives anywhere in the framework.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def factor_mesh(n_devices: int, max_model: int = 2) -> Tuple[int, int]:
+    """Split ``n_devices`` into (data, model) sizes. Model parallelism
+    beyond a few ways does not pay for a 1024-wide FT, so ``model`` is
+    capped and the rest goes to data parallelism."""
+    model = 1
+    for cand in range(min(max_model, n_devices), 0, -1):
+        if n_devices % cand == 0:
+            model = cand
+            break
+    return n_devices // model, model
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    data: Optional[int] = None,
+    model: Optional[int] = None,
+) -> Mesh:
+    """Build a ("data", "model") mesh over the given (default: all)
+    devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if data is None and model is None:
+        data, model = factor_mesh(n)
+    elif data is None:
+        data = n // model
+    elif model is None:
+        model = n // data
+    if data * model != n:
+        raise ValueError(f"mesh {data}x{model} != {n} devices")
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dimension over BOTH mesh axes — for
+    inference there is no reason to leave the model axis idle."""
+    return NamedSharding(mesh, P((DATA_AXIS, MODEL_AXIS)))
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return int(math.ceil(n / multiple)) * multiple
+
+
+class ShardedEvaluator:
+    """Batched NNUE evaluation sharded across a mesh.
+
+    Params are replicated (the whole net is ~47 MiB — tiny next to HBM)
+    and the microbatch is split over every device; XLA turns the final
+    gather of per-position scores into an all-gather over ICI. This is
+    the multi-chip version of ``evaluate_batch_jit`` and plugs into
+    ``SearchService`` via the ``eval_fn`` seam.
+    """
+
+    def __init__(self, params, mesh: Optional[Mesh] = None, batch_capacity: int = 1024):
+        from fishnet_tpu.nnue.jax_eval import evaluate_batch
+
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_devices = self.mesh.devices.size
+        self.batch_capacity = pad_to_multiple(batch_capacity, self.n_devices)
+        self.params = jax.device_put(params, replicated(self.mesh))
+        in_shard = batch_sharding(self.mesh)
+        self._fn = jax.jit(
+            evaluate_batch,
+            in_shardings=(replicated(self.mesh), in_shard, in_shard),
+            out_shardings=replicated(self.mesh),
+        )
+
+    def __call__(self, params, indices, buckets):
+        # Signature-compatible with evaluate_batch_jit; `params` must be
+        # the tree passed at construction (already device_put).
+        return self._fn(self.params, indices, buckets)

@@ -1,0 +1,17 @@
+"""NNUE training: float model, quantization export, sharded trainer."""
+
+from fishnet_tpu.train.model import NetConfig, clip_params, forward, init_params, quantize
+from fishnet_tpu.train.trainer import Batch, Trainer, TrainState, batch_specs, param_specs
+
+__all__ = [
+    "Batch",
+    "NetConfig",
+    "Trainer",
+    "TrainState",
+    "batch_specs",
+    "clip_params",
+    "forward",
+    "init_params",
+    "param_specs",
+    "quantize",
+]

@@ -32,6 +32,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: full-spec-shape tests (heavier)")
+
+
 @pytest.fixture
 def anyio_backend():
     # aiohttp requires asyncio; never run async tests on trio.
