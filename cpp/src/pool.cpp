@@ -43,10 +43,12 @@ int copy_str(const std::string& s, char* buf, int len) {
 struct Slot;
 
 // EvalBridge that extracts features and suspends the calling fiber.
+// Block requests (prefetched siblings/children) ride one suspension.
 class BatchedEval : public EvalBridge {
  public:
   explicit BatchedEval(Slot* slot) : slot_(slot) {}
   int evaluate(const Position& pos) override;
+  void evaluate_block(const Position* positions, int n, int32_t* out) override;
 
  private:
   Slot* slot_;
@@ -63,25 +65,41 @@ struct Slot {
   bool active = false;     // submitted, not yet released
   bool started = false;    // fiber launched
   bool finished = false;   // search complete, result ready
-  bool wants_eval = false; // suspended waiting for a score
+  bool wants_eval = false; // suspended waiting for scores
   bool use_scalar = false; // evaluate immediately with the scalar net
   bool stop_requested = false;
-  // Eval request state (valid while wants_eval).
-  int32_t features[2][NNUE_MAX_ACTIVE];
-  int bucket = 0;
-  int32_t eval_value = 0;
+  // Eval request state (valid while wants_eval): a block of 1..EVAL_BLOCK_MAX.
+  int block_n = 0;
+  int32_t features[EVAL_BLOCK_MAX][2][NNUE_MAX_ACTIVE];
+  int32_t buckets[EVAL_BLOCK_MAX];
+  int32_t eval_values[EVAL_BLOCK_MAX];
 };
 
-int BatchedEval::evaluate(const Position& pos) {
-  for (int p = 0; p < 2; p++) {
-    int n = nnue_features(pos, p == 0 ? pos.stm : ~pos.stm, slot_->features[p]);
-    for (int i = n; i < NNUE_MAX_ACTIVE; i++) slot_->features[p][i] = NNUE_FEATURES;
+void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out) {
+  if (n <= 0) return;
+  if (n > EVAL_BLOCK_MAX) n = EVAL_BLOCK_MAX;
+  for (int j = 0; j < n; j++) {
+    const Position& pos = positions[j];
+    for (int p = 0; p < 2; p++) {
+      int cnt = nnue_features(pos, p == 0 ? pos.stm : ~pos.stm,
+                              slot_->features[j][p]);
+      for (int i = cnt; i < NNUE_MAX_ACTIVE; i++)
+        slot_->features[j][p][i] = NNUE_FEATURES;
+    }
+    slot_->buckets[j] = nnue_psqt_bucket(pos);
   }
-  slot_->bucket = nnue_psqt_bucket(pos);
+  slot_->block_n = n;
   slot_->wants_eval = true;
   slot_->fiber->yield();
   slot_->wants_eval = false;
-  return slot_->eval_value;
+  slot_->block_n = 0;
+  for (int j = 0; j < n; j++) out[j] = slot_->eval_values[j];
+}
+
+int BatchedEval::evaluate(const Position& pos) {
+  int32_t v = 0;
+  evaluate_block(&pos, 1, &v);
+  return v;
 }
 
 }  // namespace
@@ -91,7 +109,9 @@ struct SearchPool {
   std::unique_ptr<NnueNet> scalar_net;
   std::unique_ptr<ScalarEval> scalar_eval;
   std::vector<std::unique_ptr<Slot>> slots;
-  std::vector<int> last_batch;   // slot ids of the last step()'s evals
+  // (slot id, index within the slot's block) per entry of the last
+  // step()'s eval batch, in emission order.
+  std::vector<std::pair<int, int>> last_batch;
   std::deque<int> finished_queue;
   // Worst case per fiber.h's sizing analysis (MAX_PLY frames + qsearch
   // tail at ~2.5 KB/frame): needs the full 512 KB; pages commit lazily.
@@ -193,7 +213,31 @@ void fc_pool_stop(SearchPool* pool, int slot_id) {
 // Writes up to `capacity` pending eval requests (features [i][2][32],
 // bucket [i], slot id [i]) and returns the count. Returns 0 when no
 // fiber is waiting for evals (check fc_pool_finished for results).
-int fc_pool_step(SearchPool* pool, int32_t* out_features, int32_t* out_buckets,
+namespace {
+
+// Append slot i's whole eval block to the outgoing batch if it fits.
+// Features go out as uint16 (22528 fits): half the bytes across the
+// host->device link, which is a scarce resource.
+bool emit_block(SearchPool* pool, int i, uint16_t* out_features,
+                int32_t* out_buckets, int32_t* out_slots, int capacity) {
+  Slot& slot = *pool->slots[i];
+  int base = int(pool->last_batch.size());
+  if (base + slot.block_n > capacity) return false;  // wait for next step
+  for (int j = 0; j < slot.block_n; j++) {
+    int idx = base + j;
+    uint16_t* dst = out_features + size_t(idx) * 2 * NNUE_MAX_ACTIVE;
+    const int32_t* src = &slot.features[j][0][0];
+    for (int f = 0; f < 2 * NNUE_MAX_ACTIVE; f++) dst[f] = uint16_t(src[f]);
+    out_buckets[idx] = slot.buckets[j];
+    out_slots[idx] = i;
+    pool->last_batch.emplace_back(i, j);
+  }
+  return true;
+}
+
+}  // namespace
+
+int fc_pool_step(SearchPool* pool, uint16_t* out_features, int32_t* out_buckets,
                  int32_t* out_slots, int capacity) {
   pool->last_batch.clear();
 
@@ -221,52 +265,39 @@ int fc_pool_step(SearchPool* pool, int32_t* out_features, int32_t* out_buckets,
       slot.finished = true;
       pool->finished_queue.push_back(int(i));
     } else if (slot.wants_eval) {
-      if (int(pool->last_batch.size()) < capacity) {
-        int idx = int(pool->last_batch.size());
-        memcpy(out_features + size_t(idx) * 2 * NNUE_MAX_ACTIVE, slot.features,
-               sizeof(slot.features));
-        out_buckets[idx] = slot.bucket;
-        out_slots[idx] = int(i);
-        pool->last_batch.push_back(int(i));
-      }
-      // Slots beyond capacity stay suspended; they are picked up by the
-      // next step() because wants_eval stays true and they appear in the
-      // scan below.
+      emit_block(pool, int(i), out_features, out_buckets, out_slots, capacity);
+      // Blocks that don't fit stay suspended; wants_eval stays true and
+      // the scan below picks them up next step.
     }
   }
 
   // Include fibers still waiting from a previous over-capacity step.
-  if (int(pool->last_batch.size()) < capacity) {
-    for (size_t i = 0; i < pool->slots.size(); i++) {
-      Slot& slot = *pool->slots[i];
-      if (!slot.active || slot.finished || !slot.wants_eval) continue;
-      bool already = false;
-      for (int id : pool->last_batch)
-        if (id == int(i)) {
-          already = true;
-          break;
-        }
-      if (already) continue;
-      if (int(pool->last_batch.size()) >= capacity) break;
-      int idx = int(pool->last_batch.size());
-      memcpy(out_features + size_t(idx) * 2 * NNUE_MAX_ACTIVE, slot.features,
-             sizeof(slot.features));
-      out_buckets[idx] = slot.bucket;
-      out_slots[idx] = int(i);
-      pool->last_batch.push_back(int(i));
-    }
+  for (size_t i = 0; i < pool->slots.size(); i++) {
+    if (int(pool->last_batch.size()) >= capacity) break;
+    Slot& slot = *pool->slots[i];
+    if (!slot.active || slot.finished || !slot.wants_eval) continue;
+    bool already = false;
+    for (auto& [sid, bidx] : pool->last_batch)
+      if (sid == int(i)) {
+        already = true;
+        break;
+      }
+    if (already) continue;
+    emit_block(pool, int(i), out_features, out_buckets, out_slots, capacity);
   }
 
   return int(pool->last_batch.size());
 }
 
 // Provide centipawn scores for the last step()'s batch, in order.
-// The fibers resume on the next fc_pool_step call.
+// A fiber resumes (on the next fc_pool_step) once its whole block has
+// values; the service always provides all n requested.
 void fc_pool_provide(SearchPool* pool, const int32_t* values, int n) {
   for (int i = 0; i < n && i < int(pool->last_batch.size()); i++) {
-    Slot& slot = *pool->slots[pool->last_batch[i]];
-    slot.eval_value = values[i];
-    slot.wants_eval = false;  // runnable again
+    auto [sid, bidx] = pool->last_batch[i];
+    Slot& slot = *pool->slots[sid];
+    slot.eval_values[bidx] = values[i];
+    if (bidx == slot.block_n - 1) slot.wants_eval = false;  // runnable again
   }
   pool->last_batch.clear();
 }

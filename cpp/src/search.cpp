@@ -156,6 +156,48 @@ void Search::order_moves(const Position& pos, MoveList& moves, Move tt_move,
   }
 }
 
+int Search::prefetch_evals(const Position& pos, const MoveList& children,
+                           bool captures_only, bool include_self) {
+  // Block buffers live on the Search object, not the fiber stack (24
+  // Position copies would blow the per-frame stack budget). Safe: the
+  // block completes before any recursion, so this is never re-entered.
+  int k = 0;
+  if (include_self) {
+    prefetch_block_[k] = pos;
+    prefetch_keys_[k] = pos.hash;
+    k++;
+  }
+  for (Move m : children) {
+    if (k >= EVAL_BLOCK_MAX) break;
+    if (captures_only && pos.empty(move_to(m)) && move_kind(m) != MK_EN_PASSANT &&
+        move_promo(m) != QUEEN)
+      continue;
+    Position child = pos;
+    child.make(m);
+    if (child.in_check()) continue;  // won't stand pat; eval unused
+    bool hit;
+    TTEntry* te = tt_->probe(child.hash, hit);
+    if (hit && te->eval != EVAL_NONE) continue;  // already cached
+    prefetch_block_[k] = child;
+    prefetch_keys_[k] = child.hash;
+    k++;
+  }
+  if (k == 0) return 0;
+  int32_t vals[EVAL_BLOCK_MAX];
+  eval_->evaluate_block(prefetch_block_, k, vals);
+  constexpr int LIMIT = VALUE_MATE_IN_MAX - 1;
+  int self_value = 0;
+  for (int i = 0; i < k; i++) {
+    int v = vals[i] < -LIMIT ? -LIMIT : (vals[i] > LIMIT ? LIMIT : vals[i]);
+    if (include_self && i == 0) self_value = v;
+    bool hit;
+    TTEntry* te = tt_->probe(prefetch_keys_[i], hit);
+    if (!hit) tt_->store(prefetch_keys_[i], MOVE_NONE, 0, v, 0, TT_NONE);
+    else if (te->eval == EVAL_NONE) te->eval = int16_t(v);
+  }
+  return self_value;
+}
+
 int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
   nodes_++;
   if (allow_stop_ &&
@@ -164,28 +206,36 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
   if (stopped_ || ply >= MAX_PLY) return evaluate(pos);
 
   bool in_check = pos.in_check();
+
+  // Moves first: detects mate/stalemate before spending an eval, and the
+  // list feeds both the stand-pat prefetch and the capture loop below.
+  MoveList moves;
+  pos.legal_moves(moves);
+  if (moves.size == 0) return in_check ? -(VALUE_MATE - ply) : VALUE_DRAW;
+
   int best = -VALUE_INF;
 
-  if (!in_check) {
-    // Stand pat, with the TT's cached static eval when available.
+  if (in_check) {
+    // Every evasion is searched below and most land in quiet positions
+    // needing a stand-pat eval: fetch them all in one round-trip.
+    prefetch_evals(pos, moves, /*captures_only=*/false, /*include_self=*/false);
+  } else {
+    // Stand pat, with the TT's cached static eval when available. On a
+    // miss, evaluate this node AND its capture children in one
+    // round-trip — the recursion below then stands pat from the TT.
     bool hit;
     TTEntry* tte = tt_->probe(pos.hash, hit);
     int stand;
     if (hit && tte->eval != EVAL_NONE) {
       stand = tte->eval;
     } else {
-      stand = evaluate(pos);
-      if (!hit) tt_->store(pos.hash, MOVE_NONE, 0, stand, 0, TT_NONE);
-      else tte->eval = int16_t(stand);
+      stand = prefetch_evals(pos, moves, /*captures_only=*/true,
+                             /*include_self=*/true);
     }
     if (stand >= beta) return stand;
     if (stand > alpha) alpha = stand;
     best = stand;
   }
-
-  MoveList moves;
-  pos.legal_moves(moves);
-  if (moves.size == 0) return in_check ? -(VALUE_MATE - ply) : VALUE_DRAW;
 
   // In check: search every evasion. Otherwise captures/promotions only.
   MoveList targets;
@@ -270,6 +320,12 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
   if (moves.size == 0) return in_check ? -(VALUE_MATE - ply) : VALUE_DRAW;
 
   order_moves(pos, moves, tt_move, ply);
+
+  // Frontier prefetch: at depth 1 every child is about to become a
+  // qsearch root needing a stand-pat eval — fetch them all in one
+  // round-trip instead of one each.
+  if (depth == 1) prefetch_evals(pos, moves, /*captures_only=*/false,
+                                 /*include_self=*/false);
 
   Move best_move = MOVE_NONE;
   int best = -VALUE_INF;

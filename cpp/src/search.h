@@ -25,6 +25,12 @@ constexpr int MAX_PLY = 128;
 constexpr int VALUE_MATE_IN_MAX = VALUE_MATE - MAX_PLY;
 constexpr int VALUE_DRAW = 0;
 
+// Max positions a search may request in one eval round-trip (the node
+// itself plus prefetched siblings/children). Sized to cover a full
+// legal-move list (~30-35 typical) so a depth-1 frontier prefetch almost
+// never truncates into follow-up single-eval round-trips.
+constexpr int EVAL_BLOCK_MAX = 40;
+
 // Centipawn eval provider. Implementations: scalar NNUE (immediate) or
 // the fiber pool's batching bridge (suspends).
 class EvalBridge {
@@ -32,6 +38,13 @@ class EvalBridge {
   virtual ~EvalBridge() = default;
   // Static eval of pos from the side to move's point of view.
   virtual int evaluate(const Position& pos) = 0;
+  // Evaluate n (<= EVAL_BLOCK_MAX) positions in ONE round-trip. The
+  // batching bridge suspends the fiber once for the whole block — this
+  // is the search's lever against device latency; extra speculative
+  // evals are nearly free on an otherwise idle accelerator.
+  virtual void evaluate_block(const Position* positions, int n, int32_t* out) {
+    for (int i = 0; i < n; i++) out[i] = evaluate(positions[i]);
+  }
 };
 
 class ScalarEval : public EvalBridge {
@@ -114,6 +127,12 @@ class Search {
                  bool is_pv);
   int qsearch(const Position& pos, int alpha, int beta, int ply);
   int evaluate(const Position& pos);
+  // Evaluate `pos` plus up to EVAL_BLOCK_MAX-1 of the given children in
+  // one round-trip, caching every result as a TT static eval. Children
+  // that are in check or already TT-cached are skipped. Returns pos's
+  // eval. `include_self`=false prefetches children only (returns 0).
+  int prefetch_evals(const Position& pos, const MoveList& children,
+                     bool captures_only, bool include_self);
   bool is_repetition_or_50(const Position& pos, int ply) const;
   void order_moves(const Position& pos, MoveList& moves, Move tt_move, int ply);
 
@@ -133,6 +152,9 @@ class Search {
   Move pv_table_[MAX_PLY][MAX_PLY];
   int pv_len_[MAX_PLY];
   std::vector<Move> excluded_root_moves_;  // for MultiPV iteration
+  // Scratch for prefetch_evals (kept off the fiber stack; non-reentrant).
+  Position prefetch_block_[EVAL_BLOCK_MAX];
+  uint64_t prefetch_keys_[EVAL_BLOCK_MAX];
 };
 
 // Convert an internal value to (is_mate, value-for-uci): mate distance in

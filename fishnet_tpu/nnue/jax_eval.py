@@ -62,9 +62,12 @@ def _trunc_div(a: jax.Array, d: int) -> jax.Array:
 
 
 def evaluate_batch(params: Params, indices: jax.Array, buckets: jax.Array) -> jax.Array:
-    """Evaluate a batch. indices: int32 [B, 2, 32] (stm perspective first,
-    padded with NUM_FEATURES); buckets: int32 [B]. Returns int32 [B]
-    centipawn scores from the side to move's point of view."""
+    """Evaluate a batch. indices: integer [B, 2, 32] (stm perspective
+    first, padded with NUM_FEATURES) — uint16 on the wire from the native
+    pool (half the host->device bytes), any int dtype accepted; buckets:
+    int32 [B]. Returns int32 [B] centipawn scores from the side to move's
+    point of view."""
+    indices = indices.astype(jnp.int32)
     # Feature transformer: embedding gather + sum (int32 accumulation).
     rows = jnp.take(params["ft_w"], indices, axis=0)  # [B, 2, 32, L1] int16
     acc = params["ft_b"].astype(jnp.int32) + jnp.sum(

@@ -20,7 +20,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -68,7 +68,7 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib.fc_pool_stop.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.fc_pool_step.argtypes = [
         ctypes.c_void_p,
-        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
     ]
     lib.fc_pool_step.restype = ctypes.c_int
@@ -107,6 +107,7 @@ class SearchService:
         batch_capacity: int = 256,
         tt_bytes: int = 64 << 20,
         backend: str = "jax",  # "jax" | "scalar"
+        eval_sizes: Optional[Sequence[int]] = None,
     ) -> None:
         self._lib = load()
         _bind_pool_api(self._lib)
@@ -144,7 +145,23 @@ class SearchService:
 
         # Driver state. Buffers must exist before the thread starts.
         cap = batch_capacity
-        self._feat_buf = np.empty((cap, 2, spec.MAX_ACTIVE_FEATURES), dtype=np.int32)
+        # Shape buckets for _evaluate. Each distinct size is one XLA
+        # compile (slow through a device tunnel) — callers with a known
+        # steady-state load should pass just two or three sizes.
+        if eval_sizes is not None:
+            sizes = sorted({min(int(s), cap) for s in eval_sizes if s > 0})
+            if not sizes or sizes[-1] != cap:
+                sizes.append(cap)
+            self._eval_sizes = sizes
+        else:
+            self._eval_sizes = []
+            s = 64
+            while s < cap:
+                self._eval_sizes.append(s)
+                s *= 2
+            self._eval_sizes.append(cap)
+        # uint16 feature indices: half the host->device transfer bytes.
+        self._feat_buf = np.empty((cap, 2, spec.MAX_ACTIVE_FEATURES), dtype=np.uint16)
         self._bucket_buf = np.empty((cap,), dtype=np.int32)
         self._slot_buf = np.empty((cap,), dtype=np.int32)
         self._pending: Dict[int, _Pending] = {}
@@ -179,6 +196,19 @@ class SearchService:
         self._wake.set()
         return await future
 
+    def warmup(self) -> None:
+        """Compile every eval-size bucket with dummy data. Call before
+        timing anything: a first-touch compile mid-traffic stalls the
+        whole driver loop for seconds to minutes on tunneled devices."""
+        if self._eval_fn is None:
+            return
+        for s in self._eval_sizes:
+            feats = np.full(
+                (s, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.uint16
+            )
+            bucks = np.zeros((s,), np.int32)
+            np.asarray(self._eval_fn(self._params, feats, bucks))
+
     def _maybe_stop(self, slot: int, pending: _Pending) -> None:
         """Movetime watchdog (event-loop thread): hand the stop request to
         the driver thread, which owns the pool and the slot mapping —
@@ -212,13 +242,23 @@ class SearchService:
     # -- evaluation -------------------------------------------------------
 
     def _evaluate(self, n: int) -> np.ndarray:
-        feats = self._feat_buf
-        buckets = self._bucket_buf
-        if self._eval_fn is not None:
-            # Fixed-shape batch (padded) so XLA compiles exactly once.
-            values = np.asarray(self._eval_fn(self._params, feats, buckets))
-            return values[:n].astype(np.int32)
-        raise NativeCoreError("no evaluator")  # pragma: no cover
+        if self._eval_fn is None:
+            raise NativeCoreError("no evaluator")  # pragma: no cover
+        # Size-bucketed shapes: ship the smallest power-of-two slice that
+        # covers n. Each bucket compiles once; a lightly-loaded step then
+        # transfers KBs, not the full batch_capacity buffer (the
+        # host->device link is the bottleneck resource).
+        size = self._eval_sizes[-1]
+        for s in self._eval_sizes:
+            if n <= s:
+                size = s
+                break
+        self._feat_buf[n:size] = spec.NUM_FEATURES
+        self._bucket_buf[n:size] = 0
+        values = np.asarray(
+            self._eval_fn(self._params, self._feat_buf[:size], self._bucket_buf[:size])
+        )
+        return values[:n].astype(np.int32)
 
     # -- driver thread ----------------------------------------------------
 
@@ -233,7 +273,7 @@ class SearchService:
     def _drive_inner(self) -> None:
         lib = self._lib
         cap = self.batch_capacity
-        feat_ptr = self._feat_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        feat_ptr = self._feat_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
         bucket_ptr = self._bucket_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         slot_ptr = self._slot_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
 
@@ -281,9 +321,6 @@ class SearchService:
             # Advance fibers to their leaves; fill the eval batch.
             n = lib.fc_pool_step(self._pool, feat_ptr, bucket_ptr, slot_ptr, cap)
             if n > 0:
-                # Pad the tail so stale indices can't go out of range.
-                self._feat_buf[n:] = spec.NUM_FEATURES
-                self._bucket_buf[n:] = 0
                 values = self._evaluate(n)
                 arr = np.ascontiguousarray(values, dtype=np.int32)
                 lib.fc_pool_provide(
