@@ -1,0 +1,85 @@
+"""A minimal scripted UCI engine for driver tests.
+
+Speaks just enough UCI to exercise fishnet_tpu.engine.uci: handshake,
+options, position/go, multipv info lines, bestmove. Behavior toggles via
+env vars:
+
+* FAKE_UCI_DIE_ON_GO=1   — exit silently when `go` arrives (crash test);
+* FAKE_UCI_NO_SCORE=1    — send bestmove without any info score
+  (protocol-violation test);
+* FAKE_UCI_MATE=1        — report a terminal position (`score mate 0`,
+  no pv, `bestmove (none)`), as Stockfish does for checkmate/stalemate.
+"""
+
+import os
+import sys
+
+
+def say(line):
+    sys.stdout.write(line + "\n")
+    sys.stdout.flush()
+
+
+def main():
+    multipv = 1
+    variant = "chess"
+    last_go = ""
+    for raw in sys.stdin:
+        line = raw.strip()
+        tokens = line.split()
+        if not tokens:
+            continue
+        cmd = tokens[0]
+        if cmd == "uci":
+            say("id name FakeUCI 1.0")
+            say("option name Hash type spin default 16 min 1 max 1024")
+            say("option name MultiPV type spin default 1 min 1 max 500")
+            say("option name Skill Level type spin default 20 min -9 max 20")
+            say("option name Use NNUE type check default true")
+            say("option name UCI_Chess960 type check default false")
+            say("option name UCI_AnalyseMode type check default false")
+            say("option name UCI_Variant type combo default chess var chess var atomic var antichess")
+            say("uciok")
+        elif cmd == "isready":
+            say("readyok")
+        elif cmd == "setoption":
+            # setoption name <Name...> value <v>
+            if "value" in tokens:
+                vi = tokens.index("value")
+                name = " ".join(tokens[2:vi]).lower()
+                value = " ".join(tokens[vi + 1 :])
+                if name == "multipv":
+                    multipv = int(value)
+                elif name == "uci_variant":
+                    variant = value
+        elif cmd in ("ucinewgame", "position"):
+            pass
+        elif cmd == "go":
+            last_go = line
+            if os.environ.get("FAKE_UCI_DIE_ON_GO"):
+                sys.exit(3)
+            if os.environ.get("FAKE_UCI_NO_SCORE"):
+                say("bestmove e2e4")
+                continue
+            if os.environ.get("FAKE_UCI_MATE"):
+                say("info depth 0 score mate 0")
+                say("bestmove (none)")
+                continue
+            moves = ["e2e4", "d2d4", "g1f3", "c2c4"]
+            for depth in (1, 2, 3):
+                for pv in range(1, multipv + 1):
+                    say(
+                        f"info depth {depth} seldepth {depth} multipv {pv} "
+                        f"score cp {10 * depth - 5 * (pv - 1)} nodes {1000 * depth} "
+                        f"nps 500000 time {2 * depth} pv {moves[pv - 1]} e7e5"
+                    )
+            # An upperbound line must be ignored by the parser.
+            say("info depth 4 multipv 1 score cp 99 upperbound nodes 4000 nps 500000 time 9 pv e2e4")
+            say(f"info string variant={variant} go=[{last_go}]")
+            say("bestmove e2e4 ponder e7e5")
+        elif cmd == "quit":
+            return
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,217 @@
+"""Tests for the UCI subprocess engine driver against a scripted fake
+engine (tests/fake_uci_engine.py) — the driver-level analogue of the
+reference's manual Stockfish testing (SURVEY.md §4)."""
+
+import os
+import sys
+
+import pytest
+
+from fishnet_tpu.engine.base import EngineError
+from fishnet_tpu.engine.uci import UciEngine, UciEngineFactory, _parse_info_line
+from fishnet_tpu.ipc import Position
+from fishnet_tpu.protocol.types import (
+    Clock,
+    EngineFlavor,
+    NodeLimit,
+    Score,
+    SkillLevel,
+    Variant,
+    Work,
+)
+
+from fishnet_tpu.protocol.types import STARTPOS
+
+pytestmark = pytest.mark.anyio
+
+FAKE = os.path.join(os.path.dirname(__file__), "fake_uci_engine.py")
+
+
+def fake_engine(flavor=EngineFlavor.OFFICIAL):
+    return UciEngine(sys.executable, flavor, args=[FAKE])
+
+
+def analysis_work(multipv=None, depth=None):
+    return Work(
+        kind="analysis",
+        id="testbatch01",
+        nodes=NodeLimit(classical=4_050_000, sf15=1_500_000),
+        depth=depth,
+        multipv=multipv,
+        timeout_ms=7000,
+    )
+
+
+def analysis_position(work=None, moves=()):
+    return Position(
+        work=work or analysis_work(),
+        position_id=0,
+        flavor=EngineFlavor.OFFICIAL,
+        variant=Variant.STANDARD,
+        root_fen=STARTPOS,
+        moves=list(moves),
+    )
+
+
+async def test_analysis_search(monkeypatch):
+    monkeypatch.delenv("FAKE_UCI_DIE_ON_GO", raising=False)
+    engine = fake_engine()
+    try:
+        response = await engine.go(analysis_position(moves=["e2e4", "e7e5"]))
+    finally:
+        await engine.close()
+    assert response.best_move == "e2e4"
+    assert response.depth == 3
+    # The final (upperbound) info line still updates node/time counters,
+    # even though its score is not recorded.
+    assert response.nodes == 4000
+    assert response.nps == 500000
+    assert response.scores.best() == Score.cp(30)
+    assert response.pvs.best() == ["e2e4", "e7e5"]
+    # The depth-4 upperbound line must not be recorded.
+    assert response.scores.best() != Score.cp(99)
+
+
+async def test_multipv_matrix():
+    work = analysis_work(multipv=3)
+    engine = fake_engine()
+    try:
+        response = await engine.go(analysis_position(work=work))
+    finally:
+        await engine.close()
+    rows = response.scores.to_json()
+    assert len(rows) == 3  # one row per pv
+    assert rows[0][3] == Score.cp(30)
+    assert rows[2][3] == Score.cp(20)
+
+
+async def test_move_job():
+    work = Work(
+        kind="move",
+        id="testmove01",
+        level=SkillLevel.EIGHT,
+        clock=Clock(wtime_centis=3000, btime_centis=3000, inc_seconds=2),
+    )
+    engine = fake_engine(flavor=EngineFlavor.MULTI_VARIANT)
+    try:
+        response = await engine.go(
+            Position(
+                work=work,
+                position_id=0,
+                flavor=EngineFlavor.MULTI_VARIANT,
+                variant=Variant.STANDARD,
+                root_fen=STARTPOS,
+                moves=[],
+            )
+        )
+    finally:
+        await engine.close()
+    assert response.best_move == "e2e4"
+
+
+async def test_engine_crash_raises(monkeypatch):
+    monkeypatch.setenv("FAKE_UCI_DIE_ON_GO", "1")
+    engine = fake_engine()
+    try:
+        with pytest.raises(EngineError):
+            await engine.go(analysis_position())
+    finally:
+        monkeypatch.delenv("FAKE_UCI_DIE_ON_GO")
+        await engine.close()
+
+
+async def test_bestmove_without_score_raises(monkeypatch):
+    monkeypatch.setenv("FAKE_UCI_NO_SCORE", "1")
+    engine = fake_engine()
+    try:
+        with pytest.raises(EngineError):
+            await engine.go(analysis_position())
+    finally:
+        monkeypatch.delenv("FAKE_UCI_NO_SCORE")
+        await engine.close()
+
+
+async def test_terminal_position_mate_score(monkeypatch):
+    """Checkmate/stalemate: `score mate 0` arrives with no pv and
+    `bestmove (none)` — must produce a response, not an engine error."""
+    monkeypatch.setenv("FAKE_UCI_MATE", "1")
+    engine = fake_engine()
+    try:
+        response = await engine.go(analysis_position())
+    finally:
+        monkeypatch.delenv("FAKE_UCI_MATE")
+        await engine.close()
+    assert response.best_move is None
+    assert response.scores.best() == Score.mate(0)
+    assert response.pvs.best() == []
+
+
+async def test_missing_binary_raises():
+    engine = UciEngine("/nonexistent/engine-binary", EngineFlavor.OFFICIAL)
+    with pytest.raises(EngineError):
+        await engine.go(analysis_position())
+    await engine.close()
+
+
+async def test_factory_routes_flavors():
+    factory = UciEngineFactory(sys.executable, args=[FAKE])
+    official = await factory.create(EngineFlavor.OFFICIAL)
+    variant = await factory.create(EngineFlavor.MULTI_VARIANT)
+    assert isinstance(official, UciEngine)
+    assert official.flavor is EngineFlavor.OFFICIAL
+    assert variant.flavor is EngineFlavor.MULTI_VARIANT
+    await official.close()
+    await variant.close()
+
+
+async def test_uci_end_to_end_with_client():
+    """The minimum end-to-end slice of SURVEY.md §7 step 3: a real
+    analysis batch from the fake lichess server through a (scripted) UCI
+    engine subprocess and back."""
+    import asyncio
+
+    from fishnet_tpu.client import Client
+    from fishnet_tpu.utils.logger import Logger
+    from tests.fake_server import VALID_KEY, FakeServer
+
+    async def wait_for(predicate, timeout=10.0):
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
+            if predicate():
+                return True
+            await asyncio.sleep(0.02)
+        return False
+
+    async with FakeServer() as server:
+        work_id = server.lichess.add_analysis_job(moves="e2e4 e7e5 g1f3")
+        client = Client(
+            endpoint=server.endpoint,
+            key=VALID_KEY,
+            cores=2,
+            engine_factory=UciEngineFactory(sys.executable, args=[FAKE]),
+            logger=Logger(verbose=0),
+            max_backoff=0.2,
+        )
+        await client.start()
+        assert await wait_for(lambda: work_id in server.lichess.analyses)
+        await client.stop()
+
+        parts = server.lichess.analyses[work_id]["analysis"]
+        assert len(parts) == 4
+        for part in parts:
+            assert part["depth"] == 3
+            assert part["score"] == {"cp": 30}
+            assert part["pv"] == "e2e4 e7e5"
+
+
+def test_parse_info_line():
+    fields = _parse_info_line(
+        "info depth 20 seldepth 30 multipv 2 score mate -3 nodes 12345 nps 1000 time 44 pv a2a4 b7b5".split()
+    )
+    assert fields["depth"] == 20
+    assert fields["multipv"] == 2
+    assert fields["score"] == Score.mate(-3)
+    assert fields["pv"] == ["a2a4", "b7b5"]
+    assert fields["nodes"] == 12345
+    # `string` payloads terminate parsing
+    assert "pv" not in _parse_info_line("info string hello pv world".split())
