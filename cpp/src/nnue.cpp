@@ -69,7 +69,8 @@ std::string NnueNet::load(const std::string& path) {
   return "";
 }
 
-int nnue_features(const Position& pos, Color perspective, int32_t* out) {
+template <typename T>
+int nnue_features(const Position& pos, Color perspective, T* out) {
   Square ksq = pos.king_sq(perspective);
   int flip = perspective == BLACK ? 56 : 0;
   int k0 = ksq ^ flip;
@@ -87,10 +88,13 @@ int nnue_features(const Position& pos, Color perspective, int32_t* out) {
     Color c = piece_color(pc);
     int plane = t == KING ? 10 : 2 * int(t) + (c != perspective ? 1 : 0);
     int osq = s ^ flip ^ mirror;
-    out[n++] = base + plane * 64 + osq;
+    out[n++] = T(base + plane * 64 + osq);
   }
   return n;
 }
+
+template int nnue_features<int32_t>(const Position&, Color, int32_t*);
+template int nnue_features<uint16_t>(const Position&, Color, uint16_t*);
 
 int nnue_evaluate(const NnueNet& net, const Position& pos) {
   int32_t acc[COLOR_NB][NNUE_L1];

@@ -40,8 +40,13 @@ struct NnueNet {
 };
 
 // HalfKAv2_hm active features for one perspective. Writes feature indices
-// to out (capacity NNUE_MAX_ACTIVE); returns the count.
-int nnue_features(const Position& pos, Color perspective, int32_t* out);
+// to out (capacity NNUE_MAX_ACTIVE); returns the count. Templated over
+// the index type: int32 for the scalar eval, uint16 for the device batch
+// (all indices < 22528 fit).
+template <typename T>
+int nnue_features(const Position& pos, Color perspective, T* out);
+extern template int nnue_features<int32_t>(const Position&, Color, int32_t*);
+extern template int nnue_features<uint16_t>(const Position&, Color, uint16_t*);
 
 // Layer-stack / PSQT bucket: (piece count - 1) / 4, clamped.
 inline int nnue_psqt_bucket(const Position& pos) {

@@ -20,6 +20,7 @@
 // shared transposition table needs no locks and lets positions from the
 // same game (adjacent plies across batch positions) share work.
 
+#include <algorithm>
 #include <cstring>
 #include <deque>
 #include <memory>
@@ -49,6 +50,7 @@ class BatchedEval : public EvalBridge {
   explicit BatchedEval(Slot* slot) : slot_(slot) {}
   int evaluate(const Position& pos) override;
   void evaluate_block(const Position* positions, int n, int32_t* out) override;
+  bool batched() const override { return true; }
 
  private:
   Slot* slot_;
@@ -69,31 +71,36 @@ struct Slot {
   bool use_scalar = false; // evaluate immediately with the scalar net
   bool stop_requested = false;
   // Eval request state (valid while wants_eval): a block of 1..EVAL_BLOCK_MAX.
+  // Features are stored as uint16 (indices < 22528): half the memory per
+  // slot and the emission into the device batch is a straight memcpy.
   int block_n = 0;
-  int32_t features[EVAL_BLOCK_MAX][2][NNUE_MAX_ACTIVE];
+  uint16_t features[EVAL_BLOCK_MAX][2][NNUE_MAX_ACTIVE];
   int32_t buckets[EVAL_BLOCK_MAX];
   int32_t eval_values[EVAL_BLOCK_MAX];
 };
 
 void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out) {
-  if (n <= 0) return;
-  if (n > EVAL_BLOCK_MAX) n = EVAL_BLOCK_MAX;
-  for (int j = 0; j < n; j++) {
-    const Position& pos = positions[j];
-    for (int p = 0; p < 2; p++) {
-      int cnt = nnue_features(pos, p == 0 ? pos.stm : ~pos.stm,
-                              slot_->features[j][p]);
-      for (int i = cnt; i < NNUE_MAX_ACTIVE; i++)
-        slot_->features[j][p][i] = NNUE_FEATURES;
+  // Honor the base-class contract for any n: one suspension per chunk of
+  // up to EVAL_BLOCK_MAX (search never exceeds one chunk in practice).
+  for (int base = 0; base < n; base += EVAL_BLOCK_MAX) {
+    int chunk = std::min(n - base, EVAL_BLOCK_MAX);
+    for (int j = 0; j < chunk; j++) {
+      const Position& pos = positions[base + j];
+      for (int p = 0; p < 2; p++) {
+        int cnt = nnue_features(pos, p == 0 ? pos.stm : ~pos.stm,
+                                slot_->features[j][p]);
+        for (int i = cnt; i < NNUE_MAX_ACTIVE; i++)
+          slot_->features[j][p][i] = uint16_t(NNUE_FEATURES);
+      }
+      slot_->buckets[j] = nnue_psqt_bucket(pos);
     }
-    slot_->buckets[j] = nnue_psqt_bucket(pos);
+    slot_->block_n = chunk;
+    slot_->wants_eval = true;
+    slot_->fiber->yield();
+    slot_->wants_eval = false;
+    slot_->block_n = 0;
+    for (int j = 0; j < chunk; j++) out[base + j] = slot_->eval_values[j];
   }
-  slot_->block_n = n;
-  slot_->wants_eval = true;
-  slot_->fiber->yield();
-  slot_->wants_eval = false;
-  slot_->block_n = 0;
-  for (int j = 0; j < n; j++) out[j] = slot_->eval_values[j];
 }
 
 int BatchedEval::evaluate(const Position& pos) {
@@ -113,6 +120,10 @@ struct SearchPool {
   // step()'s eval batch, in emission order.
   std::vector<std::pair<int, int>> last_batch;
   std::deque<int> finished_queue;
+  // Round-robin scan origin: each step starts scanning just past the
+  // last slot served, so over-capacity steps rotate service instead of
+  // starving high-index slots (head-of-line fairness).
+  size_t rr_cursor = 0;
   // Worst case per fiber.h's sizing analysis (MAX_PLY frames + qsearch
   // tail at ~2.5 KB/frame): needs the full 512 KB; pages commit lazily.
   size_t fiber_stack = 512 * 1024;
@@ -225,9 +236,8 @@ bool emit_block(SearchPool* pool, int i, uint16_t* out_features,
   if (base + slot.block_n > capacity) return false;  // wait for next step
   for (int j = 0; j < slot.block_n; j++) {
     int idx = base + j;
-    uint16_t* dst = out_features + size_t(idx) * 2 * NNUE_MAX_ACTIVE;
-    const int32_t* src = &slot.features[j][0][0];
-    for (int f = 0; f < 2 * NNUE_MAX_ACTIVE; f++) dst[f] = uint16_t(src[f]);
+    memcpy(out_features + size_t(idx) * 2 * NNUE_MAX_ACTIVE,
+           &slot.features[j][0][0], sizeof(uint16_t) * 2 * NNUE_MAX_ACTIVE);
     out_buckets[idx] = slot.buckets[j];
     out_slots[idx] = i;
     pool->last_batch.emplace_back(i, j);
@@ -240,8 +250,23 @@ bool emit_block(SearchPool* pool, int i, uint16_t* out_features,
 int fc_pool_step(SearchPool* pool, uint16_t* out_features, int32_t* out_buckets,
                  int32_t* out_slots, int capacity) {
   pool->last_batch.clear();
+  const size_t n_slots = pool->slots.size();
 
-  for (size_t i = 0; i < pool->slots.size(); i++) {
+  // Phase 1: fibers still suspended from a previous over-capacity step
+  // have waited longest — serve them before any freshly-produced blocks
+  // can refill the batch.
+  for (size_t k = 0; k < n_slots; k++) {
+    size_t i = (pool->rr_cursor + k) % n_slots;
+    Slot& slot = *pool->slots[i];
+    if (!slot.active || slot.finished || !slot.wants_eval) continue;
+    emit_block(pool, int(i), out_features, out_buckets, out_slots, capacity);
+  }
+
+  // Phase 2: run every runnable fiber to its next leaf; emit the blocks
+  // they produce as long as they fit. (Slots emitted in phase 1 still
+  // have wants_eval set and are skipped here.)
+  for (size_t k = 0; k < n_slots; k++) {
+    size_t i = (pool->rr_cursor + k) % n_slots;
     Slot& slot = *pool->slots[i];
     if (!slot.active || slot.finished || slot.wants_eval) continue;
 
@@ -266,25 +291,14 @@ int fc_pool_step(SearchPool* pool, uint16_t* out_features, int32_t* out_buckets,
       pool->finished_queue.push_back(int(i));
     } else if (slot.wants_eval) {
       emit_block(pool, int(i), out_features, out_buckets, out_slots, capacity);
-      // Blocks that don't fit stay suspended; wants_eval stays true and
-      // the scan below picks them up next step.
+      // Blocks that don't fit stay suspended; phase 1 of the next step
+      // picks them up first.
     }
   }
 
-  // Include fibers still waiting from a previous over-capacity step.
-  for (size_t i = 0; i < pool->slots.size(); i++) {
-    if (int(pool->last_batch.size()) >= capacity) break;
-    Slot& slot = *pool->slots[i];
-    if (!slot.active || slot.finished || !slot.wants_eval) continue;
-    bool already = false;
-    for (auto& [sid, bidx] : pool->last_batch)
-      if (sid == int(i)) {
-        already = true;
-        break;
-      }
-    if (already) continue;
-    emit_block(pool, int(i), out_features, out_buckets, out_slots, capacity);
-  }
+  // Rotate: next step starts scanning just past the last slot served.
+  if (!pool->last_batch.empty())
+    pool->rr_cursor = (size_t(pool->last_batch.back().first) + 1) % n_slots;
 
   return int(pool->last_batch.size());
 }

@@ -53,6 +53,25 @@ void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
   }
 }
 
+void TranspositionTable::store_eval(uint64_t key, int eval) {
+  TTEntry* e = &entries_[key & mask_];
+  if (e->key == key) {
+    if (e->eval == TT_EVAL_NONE) e->eval = int16_t(eval);
+    return;
+  }
+  // Only claim genuinely empty entries: a speculative eval (many of which
+  // are never even visited) must not evict another search's bounds.
+  if (e->bound == TT_NONE && e->eval == TT_EVAL_NONE) {
+    e->key = key;
+    e->move = MOVE_NONE;
+    e->value = 0;
+    e->eval = int16_t(eval);
+    e->depth = 0;
+    e->bound = TT_NONE;
+    e->gen = gen_;
+  }
+}
+
 // ---------------------------------------------------------------------------
 // Value conversion
 // ---------------------------------------------------------------------------
@@ -190,10 +209,7 @@ int Search::prefetch_evals(const Position& pos, const MoveList& children,
   for (int i = 0; i < k; i++) {
     int v = vals[i] < -LIMIT ? -LIMIT : (vals[i] > LIMIT ? LIMIT : vals[i]);
     if (include_self && i == 0) self_value = v;
-    bool hit;
-    TTEntry* te = tt_->probe(prefetch_keys_[i], hit);
-    if (!hit) tt_->store(prefetch_keys_[i], MOVE_NONE, 0, v, 0, TT_NONE);
-    else if (te->eval == EVAL_NONE) te->eval = int16_t(v);
+    tt_->store_eval(prefetch_keys_[i], v);
   }
   return self_value;
 }
@@ -218,7 +234,10 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
   if (in_check) {
     // Every evasion is searched below and most land in quiet positions
     // needing a stand-pat eval: fetch them all in one round-trip.
-    prefetch_evals(pos, moves, /*captures_only=*/false, /*include_self=*/false);
+    // (Only worthwhile when evals actually batch; the scalar eval would
+    // eagerly pay for children a beta cutoff never visits.)
+    if (eval_->batched())
+      prefetch_evals(pos, moves, /*captures_only=*/false, /*include_self=*/false);
   } else {
     // Stand pat, with the TT's cached static eval when available. On a
     // miss, evaluate this node AND its capture children in one
@@ -228,9 +247,12 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
     int stand;
     if (hit && tte->eval != EVAL_NONE) {
       stand = tte->eval;
-    } else {
+    } else if (eval_->batched()) {
       stand = prefetch_evals(pos, moves, /*captures_only=*/true,
                              /*include_self=*/true);
+    } else {
+      stand = evaluate(pos);
+      tt_->store_eval(pos.hash, stand);
     }
     if (stand >= beta) return stand;
     if (stand > alpha) alpha = stand;
@@ -324,8 +346,8 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
   // Frontier prefetch: at depth 1 every child is about to become a
   // qsearch root needing a stand-pat eval — fetch them all in one
   // round-trip instead of one each.
-  if (depth == 1) prefetch_evals(pos, moves, /*captures_only=*/false,
-                                 /*include_self=*/false);
+  if (depth == 1 && eval_->batched())
+    prefetch_evals(pos, moves, /*captures_only=*/false, /*include_self=*/false);
 
   Move best_move = MOVE_NONE;
   int best = -VALUE_INF;

@@ -38,13 +38,17 @@ class EvalBridge {
   virtual ~EvalBridge() = default;
   // Static eval of pos from the side to move's point of view.
   virtual int evaluate(const Position& pos) = 0;
-  // Evaluate n (<= EVAL_BLOCK_MAX) positions in ONE round-trip. The
-  // batching bridge suspends the fiber once for the whole block — this
-  // is the search's lever against device latency; extra speculative
-  // evals are nearly free on an otherwise idle accelerator.
+  // Evaluate n positions in ONE round-trip (the batching bridge splits
+  // into suspensions of up to EVAL_BLOCK_MAX). This is the search's
+  // lever against device latency; extra speculative evals are nearly
+  // free on an otherwise idle accelerator.
   virtual void evaluate_block(const Position* positions, int n, int32_t* out) {
     for (int i = 0; i < n; i++) out[i] = evaluate(positions[i]);
   }
+  // True when evaluate_block amortizes round-trip latency (device
+  // batching). Speculative prefetches only pay off then; on a scalar
+  // CPU eval they are pure waste.
+  virtual bool batched() const { return false; }
 };
 
 class ScalarEval : public EvalBridge {
@@ -79,6 +83,10 @@ class TranspositionTable {
   explicit TranspositionTable(size_t bytes = 256ull << 20);
   TTEntry* probe(uint64_t key, bool& hit);
   void store(uint64_t key, Move move, int value, int eval, int depth, TTBound bound);
+  // Cache a speculative static eval without ever evicting an entry that
+  // carries a search bound for a different key — prefetched evals are
+  // cheap and must not degrade the shared table's hit quality.
+  void store_eval(uint64_t key, int eval);
   void new_generation() { gen_++; }
 
  private:

@@ -203,6 +203,8 @@ class SearchService:
         if self._eval_fn is None:
             return
         for s in self._eval_sizes:
+            if self._stopping:  # close() during startup: stop compiling
+                return
             feats = np.full(
                 (s, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.uint16
             )
@@ -276,6 +278,11 @@ class SearchService:
         feat_ptr = self._feat_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
         bucket_ptr = self._bucket_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         slot_ptr = self._slot_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+        # Compile every eval-size bucket up front, on this thread: a
+        # first-touch XLA compile mid-traffic would stall every in-flight
+        # search at each bucket boundary. Submissions queue meanwhile.
+        self.warmup()
 
         while True:
             if self._stopping:
