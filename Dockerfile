@@ -1,0 +1,18 @@
+# fishnet-tpu container image (reference: Dockerfile:1-10).
+# The TPU runtime libraries (libtpu) are provided by the host / node image
+# on Cloud TPU VMs; jax[tpu] picks them up at import time.
+FROM python:3.11-slim AS builder
+WORKDIR /build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make && rm -rf /var/lib/apt/lists/*
+COPY cpp/ cpp/
+RUN make -C cpp -j"$(nproc)"
+
+FROM python:3.11-slim
+RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    aiohttp numpy
+WORKDIR /app
+COPY fishnet_tpu/ fishnet_tpu/
+COPY --from=builder /build/cpp/libfishnetcore.so cpp/libfishnetcore.so
+COPY docker-entrypoint.sh /docker-entrypoint.sh
+RUN chmod +x /docker-entrypoint.sh
+CMD ["/docker-entrypoint.sh"]

@@ -1,0 +1,19 @@
+#!/bin/bash -e
+# Env-var -> flag mapping (reference: docker-entrypoint.sh:1-13), plus the
+# TPU-era knobs (ENGINE, NNUE_FILE, MICROBATCH).
+
+args=("--no-conf" "--no-stats-file")
+
+if [ -n "$KEY" ]; then args+=("--key" "$KEY"); fi
+if [ -n "$KEY_FILE" ]; then args+=("--key-file" "$KEY_FILE"); fi
+if [ -n "$CORES" ]; then args+=("--cores" "$CORES"); fi
+if [ -n "$ENDPOINT" ]; then args+=("--endpoint" "$ENDPOINT"); fi
+if [ -n "$USER_BACKLOG" ]; then args+=("--user-backlog" "$USER_BACKLOG"); fi
+if [ -n "$SYSTEM_BACKLOG" ]; then args+=("--system-backlog" "$SYSTEM_BACKLOG"); fi
+if [ -n "$MAX_BACKOFF" ]; then args+=("--max-backoff" "$MAX_BACKOFF"); fi
+if [ -n "$ENGINE" ]; then args+=("--engine" "$ENGINE"); fi
+if [ -n "$ENGINE_EXE" ]; then args+=("--engine-exe" "$ENGINE_EXE"); fi
+if [ -n "$NNUE_FILE" ]; then args+=("--nnue-file" "$NNUE_FILE"); fi
+if [ -n "$MICROBATCH" ]; then args+=("--microbatch" "$MICROBATCH"); fi
+
+exec python -m fishnet_tpu "${args[@]}"

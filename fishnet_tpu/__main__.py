@@ -1,0 +1,185 @@
+"""Process entry point: ``python -m fishnet_tpu``.
+
+Equivalent of the reference's main()/run() supervisor
+(src/main.rs:44-260): resolve config, dispatch subcommands, then start
+the actor fleet — API actor, queue actor, one worker per core — with
+two-phase signal handling (first SIGINT drains, second aborts) and the
+120 s summary line.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+import sys
+from typing import Optional
+
+from fishnet_tpu import configure as configure_mod
+from fishnet_tpu import systemd as systemd_mod
+from fishnet_tpu.configure import ConfigError, Opt
+from fishnet_tpu.engine.base import EngineFactory
+from fishnet_tpu.sched.queue import BacklogOpt
+from fishnet_tpu.utils.logger import Logger
+from fishnet_tpu.utils.stats import StatsRecorder
+from fishnet_tpu.version import __version__
+
+LICENSE_NOTICE = """\
+fishnet-tpu is free software: you can redistribute it and/or modify it
+under the terms of the GNU General Public License as published by the
+Free Software Foundation, either version 3 of the License, or (at your
+option) any later version. It is distributed WITHOUT ANY WARRANTY; see
+https://www.gnu.org/licenses/gpl-3.0.html for the full text.
+"""
+
+
+def _check_key_over_network(endpoint: str, key: str) -> Optional[str]:
+    """Live key validation for the config dialog (configure.rs:474-492)."""
+    from fishnet_tpu.net import api as api_mod
+
+    async def check() -> Optional[str]:
+        stub, actor = api_mod.channel(endpoint, key, Logger())
+        task = asyncio.ensure_future(actor.run())
+        try:
+            err = await asyncio.wait_for(stub.check_key(), timeout=15.0)
+            return None if err is None else str(err)
+        except asyncio.TimeoutError:
+            return None  # network error: accept, like the reference retry path
+        finally:
+            actor.stop()
+            task.cancel()
+
+    try:
+        return asyncio.run(check())
+    except Exception as err:  # server unreachable: don't block configuration
+        sys.stderr.write(f"W: Could not verify key: {err}\n")
+        return None
+
+
+def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
+    """Select the backend behind the engine seam (north star: the
+    `--engine tpu-nnue` flavor replaces stockfish.rs subprocesses)."""
+    engine = opt.resolved_engine()
+    if engine == "tpu-nnue":
+        from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
+        from fishnet_tpu.nnue.weights import NnueWeights
+        from fishnet_tpu.search.service import SearchService
+
+        if opt.nnue_file:
+            service = SearchService(net_path=opt.nnue_file, batch_capacity=opt.resolved_microbatch())
+        else:
+            logger.warn("No --nnue-file given; using random NNUE weights (dev mode).")
+            service = SearchService(
+                weights=NnueWeights.random(seed=0), batch_capacity=opt.resolved_microbatch()
+            )
+        return TpuNnueEngineFactory(service)
+    if engine == "uci":
+        from fishnet_tpu.engine.uci import UciEngineFactory
+
+        if not opt.engine_exe:
+            raise ConfigError("--engine uci requires --engine-exe")
+        return UciEngineFactory(opt.engine_exe, logger=logger)
+    if engine == "mock":
+        from fishnet_tpu.engine.mock import MockEngineFactory
+
+        return MockEngineFactory()
+    raise ConfigError(f"unknown engine backend: {engine!r}")
+
+
+async def run_client(opt: Opt, logger: Logger) -> None:
+    """The supervisor loop (main.rs:76-260)."""
+    from fishnet_tpu.client import Client
+
+    from pathlib import Path
+
+    stats = StatsRecorder(
+        cores=opt.resolved_cores(),
+        stats_file=Path(opt.stats_file) if opt.stats_file else None,
+        no_stats_file=opt.no_stats_file,
+    )
+
+    client = Client(
+        endpoint=opt.resolved_endpoint(),
+        key=opt.key,
+        cores=opt.resolved_cores(),
+        engine_factory=build_engine_factory(opt, logger),
+        logger=logger,
+        stats=stats,
+        backlog=BacklogOpt(user=opt.user_backlog, system=opt.system_backlog),
+        max_backoff=opt.resolved_max_backoff(),
+    )
+
+    stop = asyncio.Event()
+    sigints = 0
+
+    def on_sigint() -> None:
+        nonlocal sigints
+        sigints += 1
+        if sigints == 1:
+            logger.fishnet_info("Stopping soon. Press ^C again to abort pending batches ...")
+            client.shutdown_soon()
+        else:
+            logger.fishnet_info("Stopping now.")
+            stop.set()
+
+    def on_sigterm() -> None:
+        logger.fishnet_info("Stopping now.")
+        stop.set()
+
+    loop = asyncio.get_running_loop()
+    try:
+        loop.add_signal_handler(signal.SIGINT, on_sigint)
+        loop.add_signal_handler(signal.SIGTERM, on_sigterm)
+    except NotImplementedError:  # non-Unix
+        pass
+
+    logger.fishnet_info(f"fishnet-tpu {__version__} connecting to {opt.resolved_endpoint()}")
+    await client.start()
+    summary = asyncio.create_task(client.run_summary_loop())
+    # Exit on explicit stop (second ^C / SIGTERM) OR when a first-^C
+    # drain completes on its own (main.rs:248-259).
+    stop_task = asyncio.create_task(stop.wait())
+    drained_task = asyncio.create_task(client.wait_drained())
+    try:
+        await asyncio.wait({stop_task, drained_task}, return_when=asyncio.FIRST_COMPLETED)
+    finally:
+        for t in (stop_task, drained_task, summary):
+            t.cancel()
+        await client.stop(abort_pending=stop.is_set())
+        logger.fishnet_info(client.stats_summary())
+
+
+def main(argv=None) -> int:
+    try:
+        opt = configure_mod.parse_and_configure(argv, key_check=_check_key_over_network)
+    except ConfigError as err:
+        sys.stderr.write(f"E: {err}\n")
+        return 2
+
+    logger = Logger(verbose=opt.verbose, stderr=opt.is_systemd())
+
+    if opt.command == "license":
+        print(LICENSE_NOTICE)
+        return 0
+    if opt.command == "systemd":
+        systemd_mod.systemd_system(opt)
+        return 0
+    if opt.command == "systemd-user":
+        systemd_mod.systemd_user(opt)
+        return 0
+    if opt.command == "configure":
+        return 0  # dialog already ran inside parse_and_configure
+
+    if opt.auto_update:
+        from fishnet_tpu.update import auto_update
+
+        auto_update(logger)
+
+    try:
+        asyncio.run(run_client(opt, logger))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

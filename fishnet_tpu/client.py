@@ -173,6 +173,14 @@ class Client:
         if self._queue_stub is not None:
             self._queue_stub.shutdown_soon()
 
+    async def wait_drained(self) -> None:
+        """Resolve when workers and queue have exited (i.e. a
+        ``shutdown_soon`` drain completed); the api actor stays up to
+        deliver final submissions."""
+        tasks = [t for t in self._tasks if t.get_name() != "api"]
+        if tasks:
+            await asyncio.wait(tasks)
+
     async def stop(self, abort_pending: bool = True) -> None:
         """Graceful stop. With ``abort_pending`` the server is told to
         reassign unfinished batches immediately (main.rs:248-249)."""

@@ -96,6 +96,13 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib._pool_bound = True
 
 
+#: Must cover the native core's largest single eval block
+#: (cpp/src/search.h:32 EVAL_BLOCK_MAX): emit_block is all-or-nothing, so
+#: a capacity below one block would never fit it and the fiber would wait
+#: forever while the driver spins.
+MIN_BATCH_CAPACITY = 40
+
+
 class SearchService:
     """Shared batched-search backend. One instance per client process."""
 
@@ -122,7 +129,7 @@ class SearchService:
             net_path = self._tmp.name
         self.net_path = str(net_path)
         self.backend = backend
-        self.batch_capacity = batch_capacity
+        self.batch_capacity = batch_capacity = max(batch_capacity, MIN_BATCH_CAPACITY)
 
         # The scalar net is always loaded into the pool: it serves the
         # "scalar" backend and is the fallback if JAX is unusable.
@@ -168,6 +175,8 @@ class SearchService:
         self._submissions: List[Tuple] = []
         self._stop_requests: List[Tuple[int, _Pending]] = []
         self._lock = threading.Lock()
+        self._warmup_lock = threading.Lock()
+        self._warmed = False
         self._wake = threading.Event()
         self._stopping = False
         self._thread = threading.Thread(target=self._drive, name="search-driver", daemon=True)
@@ -202,14 +211,21 @@ class SearchService:
         whole driver loop for seconds to minutes on tunneled devices."""
         if self._eval_fn is None:
             return
-        for s in self._eval_sizes:
-            if self._stopping:  # close() during startup: stop compiling
+        # Once-only and serialized: the driver thread warms up at start
+        # and callers (bench) may also call this — the second caller
+        # blocks until compiles finish instead of duplicating them.
+        with self._warmup_lock:
+            if self._warmed:
                 return
-            feats = np.full(
-                (s, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.uint16
-            )
-            bucks = np.zeros((s,), np.int32)
-            np.asarray(self._eval_fn(self._params, feats, bucks))
+            for s in self._eval_sizes:
+                if self._stopping:  # close() during startup: stop compiling
+                    return
+                feats = np.full(
+                    (s, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.uint16
+                )
+                bucks = np.zeros((s,), np.int32)
+                np.asarray(self._eval_fn(self._params, feats, bucks))
+            self._warmed = True
 
     def _maybe_stop(self, slot: int, pending: _Pending) -> None:
         """Movetime watchdog (event-loop thread): hand the stop request to

@@ -1,0 +1,160 @@
+"""systemd unit-file generation.
+
+Equivalent of the reference's deployment tooling (src/systemd.rs:11-191):
+``fishnet-tpu systemd`` / ``systemd-user`` print a hardened unit file
+whose ExecStart reconstructs the exact CLI invocation (flags the user
+passed, paths made absolute), so the service runs with the same config.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import sys
+from pathlib import Path
+from typing import List, Optional, TextIO
+
+from fishnet_tpu.configure import Opt
+
+
+def _duration(seconds: float) -> str:
+    """Serialize a duration so parse_duration round-trips it: integer
+    seconds when whole, else milliseconds (parse_duration rejects
+    fractional values)."""
+    if seconds == int(seconds):
+        return f"{int(seconds)}s"
+    return f"{int(round(seconds * 1000))}ms"
+
+
+def _exec_start(opt: Opt, *, absolute: bool) -> str:
+    """Rebuild the CLI invocation (systemd.rs:119-191)."""
+    if absolute:
+        exe = [sys.executable, "-m", "fishnet_tpu"]
+    else:
+        exe = [os.path.basename(sys.executable), "-m", "fishnet_tpu"]
+
+    def path(p: str) -> str:
+        return str(Path(p).resolve()) if absolute else p
+
+    args: List[str] = [shlex.quote(a) for a in exe]
+    if opt.verbose:
+        args.append("-" + "v" * opt.verbose)
+    if opt.auto_update:
+        args.append("--auto-update")
+
+    if opt.no_conf:
+        args.append("--no-conf")
+    elif opt.conf is not None or absolute:
+        args += ["--conf", shlex.quote(path(str(opt.conf_path())))]
+
+    if opt.key_file is not None:
+        args += ["--key-file", shlex.quote(path(opt.key_file))]
+    elif opt.key is not None:
+        args += ["--key", shlex.quote(opt.key)]
+
+    if opt.endpoint is not None:
+        args += ["--endpoint", shlex.quote(opt.endpoint)]
+    if opt.cores is not None:
+        args += ["--cores", shlex.quote(opt.cores)]
+    if opt.max_backoff is not None:
+        args += ["--max-backoff", _duration(opt.max_backoff)]
+    if opt.user_backlog is not None:
+        args += ["--user-backlog", _duration(opt.user_backlog)]
+    if opt.system_backlog is not None:
+        args += ["--system-backlog", _duration(opt.system_backlog)]
+    if opt.stats_file is not None:
+        args += ["--stats-file", shlex.quote(path(opt.stats_file))]
+    if opt.no_stats_file:
+        args.append("--no-stats-file")
+    if opt.engine is not None:
+        args += ["--engine", opt.engine]
+    if opt.engine_exe is not None:
+        args += ["--engine-exe", shlex.quote(path(opt.engine_exe))]
+    if opt.nnue_file is not None:
+        args += ["--nnue-file", shlex.quote(path(opt.nnue_file))]
+    if opt.microbatch is not None:
+        args += ["--microbatch", str(opt.microbatch)]
+
+    return " ".join(args)
+
+
+def systemd_system(opt: Opt, out: Optional[TextIO] = None) -> None:
+    """Hardened system unit (systemd.rs:11-55). Note: no
+    CapabilityBoundingSet surprises — the TPU runtime needs device access,
+    so DevicePolicy stays open when running the tpu-nnue backend."""
+    out = out or sys.stdout
+    tpu = opt.resolved_engine() == "tpu-nnue"
+    lines = [
+        "[Unit]",
+        "Description=Fishnet TPU client",
+        "After=network-online.target",
+        "Wants=network-online.target",
+        "",
+        "[Service]",
+        f"ExecStart={_exec_start(opt, absolute=True)} run",
+        "KillMode=mixed",
+        "WorkingDirectory=/tmp",
+        f"User={os.environ.get('USER', 'XXX')}",
+        "Nice=5",
+        "CapabilityBoundingSet=",
+        "PrivateTmp=true",
+    ]
+    if not tpu:
+        lines += ["PrivateDevices=true", "DevicePolicy=closed"]
+    lines += [
+        "ProtectSystem=full",
+        "NoNewPrivileges=true",
+        "Restart=on-failure",
+        "",
+        "[Install]",
+        "WantedBy=multi-user.target",
+    ]
+    out.write("\n".join(lines) + "\n")
+    if out is sys.stdout and sys.stdout.isatty():
+        cmd = _exec_start(opt, absolute=False)
+        sys.stderr.write(
+            "\n# Example usage:\n"
+            f"# {cmd} systemd | sudo tee /etc/systemd/system/fishnet-tpu.service\n"
+            "# systemctl enable fishnet-tpu.service\n"
+            "# systemctl start fishnet-tpu.service\n"
+            "# Live view of log: journalctl --unit fishnet-tpu --follow\n"
+            f"# Prefer a user unit? {cmd} systemd-user\n"
+        )
+
+
+def systemd_user(opt: Opt, out: Optional[TextIO] = None) -> None:
+    """User unit (systemd.rs:57-95)."""
+    out = out or sys.stdout
+    tpu = opt.resolved_engine() == "tpu-nnue"
+    lines = [
+        "[Unit]",
+        "Description=Fishnet TPU client",
+        "After=network-online.target",
+        "Wants=network-online.target",
+        "",
+        "[Service]",
+        f"ExecStart={_exec_start(opt, absolute=True)} run",
+        "KillMode=mixed",
+        "WorkingDirectory=/tmp",
+        "Nice=5",
+        "PrivateTmp=true",
+    ]
+    if not tpu:
+        lines += ["DevicePolicy=closed"]
+    lines += [
+        "ProtectSystem=full",
+        "Restart=on-failure",
+        "",
+        "[Install]",
+        "WantedBy=default.target",
+    ]
+    out.write("\n".join(lines) + "\n")
+    if out is sys.stdout and sys.stdout.isatty():
+        cmd = _exec_start(opt, absolute=False)
+        sys.stderr.write(
+            "\n# Example usage:\n"
+            f"# {cmd} systemd-user | tee ~/.config/systemd/user/fishnet-tpu.service\n"
+            "# systemctl enable --user fishnet-tpu.service\n"
+            "# systemctl start --user fishnet-tpu.service\n"
+            "# Live view of log: journalctl --user --user-unit fishnet-tpu --follow\n"
+        )

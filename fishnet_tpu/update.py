@@ -1,0 +1,129 @@
+"""Auto-update subsystem.
+
+Equivalent of the reference's ``--auto-update`` flow
+(src/main.rs:48-65, 179-199, 412-464): check a release index on startup
+and every UPDATE_INTERVAL, and when a newer version exists, finish
+draining work and re-``exec`` the process so the new code takes over.
+
+The reference self-replaces a static binary from an S3 bucket; a Python
+deployment updates its environment instead, so the update *source* is
+pluggable: ``FISHNET_TPU_UPDATE_URL`` names an HTTP JSON index
+``{"latest": "x.y.z", "command": ["pip", ...]}`` (absent ⇒ updates are a
+no-op). The drain-then-exec restart semantics are preserved exactly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+from dataclasses import dataclass
+from typing import List, Optional
+
+from fishnet_tpu.utils.logger import Logger
+from fishnet_tpu.version import __version__
+
+#: Periodic re-check cadence (main.rs:179: every 5 h, with jitter applied
+#: by the caller's select loop).
+UPDATE_INTERVAL_SECONDS = 5 * 60 * 60
+
+UPDATE_URL_ENV = "FISHNET_TPU_UPDATE_URL"
+
+
+def parse_version(v: str) -> tuple:
+    return tuple(int(p) for p in v.strip().lstrip("v").split("."))
+
+
+@dataclass
+class UpdateStatus:
+    checked: bool
+    current: str
+    latest: Optional[str] = None
+    updated: bool = False
+    command: Optional[List[str]] = None
+
+    @property
+    def update_available(self) -> bool:
+        return self.latest is not None and parse_version(self.latest) > parse_version(self.current)
+
+
+async def check_for_update(url: Optional[str] = None) -> UpdateStatus:
+    """Fetch the release index (one GET; the command rides along so
+    apply_update doesn't re-fetch a possibly changed index). Returns
+    ``checked=False`` when no update source is configured (the common,
+    zero-egress deployment)."""
+    url = url or os.environ.get(UPDATE_URL_ENV)
+    if not url:
+        return UpdateStatus(checked=False, current=__version__)
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        async with session.get(url, timeout=aiohttp.ClientTimeout(total=30)) as resp:
+            resp.raise_for_status()
+            index = json.loads(await resp.text())
+    return UpdateStatus(
+        checked=True,
+        current=__version__,
+        latest=index.get("latest"),
+        command=index.get("command"),
+    )
+
+
+async def apply_update(url: Optional[str] = None, logger: Optional[Logger] = None) -> UpdateStatus:
+    """Check and, when newer, run the index's update command
+    (e.g. a pip install). Restart is the caller's job — after draining,
+    like main.rs:257-259."""
+    logger = logger or Logger()
+    status = await check_for_update(url)
+    if not status.checked:
+        logger.debug("Auto-update: no update source configured.")
+        return status
+    if not status.update_available:
+        logger.fishnet_info(f"fishnet-tpu {__version__} is up to date.")
+        return status
+    command = status.command
+    if command:
+        logger.fishnet_info(f"Updating to {status.latest} ...")
+        proc = await asyncio.create_subprocess_exec(*command)
+        rc = await proc.wait()
+        if rc != 0:
+            logger.error(f"Update command failed with exit code {rc}.")
+            return status
+        status.updated = True
+    return status
+
+
+#: Set on exec so the restarted process doesn't loop forever when the
+#: update command succeeded but didn't actually change the installed
+#: version (wrong env, source checkout, ...).
+_ATTEMPT_ENV = "FISHNET_TPU_UPDATE_ATTEMPTED"
+
+
+def restart_process(logger: Logger, target_version: Optional[str] = None) -> None:
+    """Replace this process with a fresh invocation of the same argv
+    (main.rs:412-438, Unix exec path)."""
+    logger.fishnet_info("Restarting ...")
+    if target_version:
+        os.environ[_ATTEMPT_ENV] = target_version
+    os.execv(sys.executable, [sys.executable, "-m", "fishnet_tpu", *sys.argv[1:]])
+
+
+def auto_update(logger: Logger) -> UpdateStatus:
+    """Startup-time check (main.rs:48-65). Blocking wrapper; the periodic
+    re-check runs inside the supervisor loop via ``check_for_update``."""
+    logger.fishnet_info("Checking for updates (--auto-update) ...")
+    try:
+        status = asyncio.run(apply_update(logger=logger))
+    except Exception as err:
+        logger.error(f"Failed to check for updates: {err}")
+        return UpdateStatus(checked=False, current=__version__)
+    if status.updated:
+        if os.environ.get(_ATTEMPT_ENV) == status.latest:
+            logger.error(
+                f"Update to {status.latest} ran but the installed version is "
+                f"still {__version__}; not restarting again."
+            )
+            return status
+        restart_process(logger, status.latest)
+    return status

@@ -1,0 +1,345 @@
+"""Config layer tests: scalar parsers, CLI, ini merge precedence, the
+interactive dialog, systemd unit generation, and the auto-update hook.
+
+The reference has no tests; behavior is pinned against
+src/configure.rs / src/systemd.rs / src/main.rs:440-464 semantics."""
+
+import io
+import json
+import asyncio
+
+import pytest
+
+from fishnet_tpu import configure as cfg
+from fishnet_tpu import systemd as systemd_mod
+from fishnet_tpu import update as update_mod
+
+
+# -- scalar parsers ---------------------------------------------------------
+
+
+def test_parse_duration():
+    assert cfg.parse_duration("90s") == 90.0
+    assert cfg.parse_duration("90") == 90.0
+    assert cfg.parse_duration("2h") == 7200.0
+    assert cfg.parse_duration("1d") == 86400.0
+    assert cfg.parse_duration("3m") == 180.0
+    assert cfg.parse_duration("500ms") == 0.5
+    assert cfg.parse_duration(" 5 s".replace(" s", "s")) == 5.0
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_duration("abc")
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_duration("1.5h")  # reference parses integers only
+
+
+def test_parse_backlog():
+    assert cfg.parse_backlog("short") == 30.0
+    assert cfg.parse_backlog("long") == 3600.0
+    assert cfg.parse_backlog("120s") == 120.0
+    assert cfg.parse_backlog("0") == 0.0
+
+
+def test_parse_key():
+    assert cfg.parse_key("abcDEF123") == "abcDEF123"
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_key("")
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_key("no spaces")
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_key("ünïcode")
+
+
+def test_parse_endpoint():
+    assert cfg.parse_endpoint("https://lichess.org/fishnet/") == "https://lichess.org/fishnet"
+    assert not cfg.endpoint_is_development("https://lichess.org/fishnet")
+    assert cfg.endpoint_is_development("http://localhost:9999/fishnet")
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_endpoint("not a url")
+
+
+def test_cores():
+    assert cfg.parse_cores("auto") == "auto"
+    assert cfg.parse_cores("max") == "all"
+    assert cfg.parse_cores("4") == "4"
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_cores("0")
+    n = cfg.available_cores()
+    assert cfg.resolve_cores("auto") == max(1, n - 1)
+    assert cfg.resolve_cores("all") == n
+    assert cfg.resolve_cores("3") == 3
+    assert cfg.resolve_cores(None) == max(1, n - 1)
+
+
+def test_parse_toggle():
+    assert cfg.parse_toggle("yes") is True
+    assert cfg.parse_toggle("NAY") is False
+    assert cfg.parse_toggle("") is None
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_toggle("maybe")
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def test_cli_basic(tmp_path, monkeypatch):
+    monkeypatch.setattr(cfg, "available_cores", lambda: 8)
+    opt = cfg.parse_and_configure(
+        ["run", "--no-conf", "-k", "k3y", "--cores", "2", "--max-backoff", "10s",
+         "--user-backlog", "short", "--engine", "mock", "-vv"],
+        output=io.StringIO(),
+    )
+    assert opt.command == "run"
+    assert opt.key == "k3y"
+    assert opt.resolved_cores() == 2
+    assert opt.resolved_max_backoff() == 10.0
+    assert opt.user_backlog == 30.0
+    assert opt.resolved_engine() == "mock"
+    assert opt.verbose == 2
+    assert opt.resolved_endpoint() == cfg.DEFAULT_ENDPOINT
+
+
+def test_cli_conflicts():
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_and_configure(["--no-conf", "--key", "a", "--key-file", "x"], output=io.StringIO())
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_and_configure(["--no-conf", "--stats-file", "a", "--no-stats-file"], output=io.StringIO())
+
+
+def test_key_file(tmp_path):
+    key_file = tmp_path / "key.txt"
+    key_file.write_text("  secret123 \n")
+    opt = cfg.parse_and_configure(
+        ["run", "--no-conf", "--key-file", str(key_file)], output=io.StringIO()
+    )
+    assert opt.key == "secret123"
+
+
+def test_core_cap_warning():
+    out = io.StringIO()
+    opt = cfg.parse_and_configure(
+        ["run", "--no-conf", "--cores", str(cfg.available_cores() + 7)], output=out
+    )
+    assert opt.cores == "all"
+    assert "Capped" in out.getvalue()
+
+
+# -- ini merge --------------------------------------------------------------
+
+
+def test_ini_merge_cli_wins(tmp_path, monkeypatch):
+    monkeypatch.setattr(cfg, "available_cores", lambda: 8)
+    conf = tmp_path / "fishnet.ini"
+    conf.write_text(
+        "[Fishnet]\nKey = inikey\nCores = 3\nEndpoint = http://dev.example/fishnet\n"
+        "UserBacklog = long\nEngine = mock\n"
+    )
+    opt = cfg.parse_and_configure(
+        ["run", "--conf", str(conf), "--key", "clikey"], output=io.StringIO()
+    )
+    assert opt.key == "clikey"  # CLI wins
+    assert opt.cores == "3"  # ini fills the rest
+    assert opt.endpoint == "http://dev.example/fishnet"
+    assert opt.user_backlog == 3600.0
+    assert opt.resolved_engine() == "mock"
+
+
+def test_ini_invalid_engine(tmp_path):
+    conf = tmp_path / "fishnet.ini"
+    conf.write_text("[Fishnet]\nEngine = gpu\n")
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_and_configure(["run", "--conf", str(conf)], output=io.StringIO())
+
+
+# -- dialog -----------------------------------------------------------------
+
+
+def test_configure_dialog_writes_ini(tmp_path, monkeypatch):
+    monkeypatch.setattr(cfg, "available_cores", lambda: 8)
+    conf = tmp_path / "fishnet.ini"
+    answers = iter([
+        "badkey!!",   # invalid even with ! (second ! is not alnum)
+        "mykey99!",   # accepted, force (no network check)
+        "2",          # cores
+        "yes",        # keep idle -> short/long backlog
+    ])
+    out = io.StringIO()
+    opt = cfg.parse_and_configure(
+        ["configure", "--conf", str(conf)],
+        input_fn=lambda: next(answers),
+        output=out,
+        key_check=lambda e, k: "should not be called",
+    )
+    text = conf.read_text()
+    assert "Key = mykey99" in text
+    assert "Cores = 2" in text
+    assert "UserBacklog = short" in text
+    assert "SystemBacklog = long" in text
+    # Merged back into the Opt:
+    assert opt.key == "mykey99"
+    assert opt.cores == "2"
+
+
+def test_configure_dialog_key_check_rejects(tmp_path):
+    conf = tmp_path / "fishnet.ini"
+    attempts = []
+
+    def key_check(endpoint, key):
+        attempts.append(key)
+        return "access denied" if key == "wrong" else None
+
+    answers = iter(["wrong", "right1", "\n", "no"])
+    cfg.parse_and_configure(
+        ["configure", "--conf", str(conf), "--endpoint", "http://dev.example/f"],
+        input_fn=lambda: next(answers),
+        output=io.StringIO(),
+        key_check=key_check,
+    )
+    assert attempts == ["wrong", "right1"]
+    assert "Key = right1" in conf.read_text()
+
+
+def test_dialog_dev_endpoint_key_optional(tmp_path):
+    conf = tmp_path / "fishnet.ini"
+    answers = iter(["\n", "\n", "no"])  # empty key is OK on a dev endpoint
+    cfg.parse_and_configure(
+        ["configure", "--conf", str(conf), "--endpoint", "http://localhost:1/f"],
+        input_fn=lambda: next(answers),
+        output=io.StringIO(),
+    )
+    assert "Key" not in conf.read_text().replace("UserBacklog", "")
+
+
+def test_bare_invocation_triggers_first_run_dialog(tmp_path, monkeypatch):
+    """No subcommand + no ini = first-run dialog (configure.rs:421-423);
+    an explicit `run` skips it."""
+    monkeypatch.setattr(cfg, "available_cores", lambda: 8)
+    conf = tmp_path / "fishnet.ini"
+    answers = iter(["devkey1!", "2", "no"])
+    opt = cfg.parse_and_configure(
+        ["--conf", str(conf)],
+        input_fn=lambda: next(answers),
+        output=io.StringIO(),
+    )
+    assert opt.command is None and opt.resolved_command() == "run"
+    assert conf.exists() and opt.key == "devkey1"
+
+    # Explicit run with no ini: no dialog, no prompts consumed.
+    conf2 = tmp_path / "other.ini"
+    opt = cfg.parse_and_configure(
+        ["run", "--conf", str(conf2)],
+        input_fn=lambda: (_ for _ in ()).throw(AssertionError("dialog ran")),
+        output=io.StringIO(),
+    )
+    assert not conf2.exists()
+
+
+def test_dialog_eof_raises(tmp_path):
+    conf = tmp_path / "fishnet.ini"
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_and_configure(
+            ["configure", "--conf", str(conf)],
+            input_fn=lambda: "",  # closed stdin
+            output=io.StringIO(),
+        )
+
+
+# -- systemd ----------------------------------------------------------------
+
+
+def test_systemd_unit(tmp_path):
+    opt = cfg.Opt(command="systemd", key="sekret1", cores="4", user_backlog=30.0,
+                  engine="tpu-nnue", auto_update=True, verbose=1, conf=str(tmp_path / "f.ini"))
+    (tmp_path / "f.ini").write_text("[Fishnet]\n")
+    out = io.StringIO()
+    systemd_mod.systemd_system(opt, out)
+    unit = out.getvalue()
+    assert "[Unit]" in unit and "[Service]" in unit and "[Install]" in unit
+    assert "ExecStart=" in unit
+    assert "--key sekret1" in unit
+    assert "--cores 4" in unit
+    assert "--user-backlog 30s" in unit
+    assert "--auto-update" in unit
+    assert "-v" in unit
+    assert unit.rstrip().endswith("WantedBy=multi-user.target")
+    # TPU backend keeps device access open:
+    assert "PrivateDevices" not in unit
+    assert "Restart=on-failure" in unit
+
+
+def test_systemd_duration_and_extra_flags(tmp_path):
+    opt = cfg.Opt(command="systemd", no_conf=True, max_backoff=0.5,
+                  microbatch=4096, no_stats_file=True)
+    out = io.StringIO()
+    systemd_mod.systemd_system(opt, out)
+    unit = out.getvalue()
+    assert "--max-backoff 500ms" in unit  # 0.5s would fail parse_duration
+    assert "--microbatch 4096" in unit
+    assert "--no-stats-file" in unit
+    # Round-trip: every emitted duration must parse.
+    assert cfg.parse_duration("500ms") == 0.5
+
+
+def test_systemd_user_unit_mock_engine():
+    opt = cfg.Opt(command="systemd-user", engine="mock", no_conf=True)
+    out = io.StringIO()
+    systemd_mod.systemd_user(opt, out)
+    unit = out.getvalue()
+    assert "WantedBy=default.target" in unit
+    assert "DevicePolicy=closed" in unit  # no TPU needed for mock
+
+
+# -- auto-update ------------------------------------------------------------
+
+
+def test_parse_version():
+    assert update_mod.parse_version("v1.2.3") == (1, 2, 3)
+    assert update_mod.parse_version("0.1.0") < update_mod.parse_version("0.2.0")
+
+
+def test_update_noop_without_source(monkeypatch):
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    status = asyncio.run(update_mod.check_for_update())
+    assert not status.checked
+    assert not status.update_available
+
+
+def test_update_against_local_server(tmp_path, monkeypatch):
+    from aiohttp import web
+
+    marker = tmp_path / "updated.txt"
+    index = {
+        "latest": "99.0.0",
+        "command": ["touch", str(marker)],
+    }
+
+    hits = []
+
+    async def scenario():
+        async def handler(request):
+            hits.append(1)
+            return web.json_response(index)
+
+        app = web.Application()
+        app.router.add_get("/index.json", handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            url = f"http://127.0.0.1:{port}/index.json"
+            status = await update_mod.check_for_update(url)
+            assert status.checked and status.update_available
+            hits.clear()
+            status = await update_mod.apply_update(url)
+            assert status.updated
+            assert len(hits) == 1  # index fetched once, command rode along
+            # Same version -> no update.
+            index["latest"] = "0.0.1"
+            status = await update_mod.check_for_update(url)
+            assert not status.update_available
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(scenario())
+    assert marker.exists()

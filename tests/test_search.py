@@ -123,3 +123,26 @@ async def test_illegal_submit_rejected(service):
             "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
             ["e2e5"], depth=2,
         )
+
+
+async def test_tiny_batch_capacity_clamped():
+    """A capacity below the native core's largest eval block
+    (EVAL_BLOCK_MAX=40, cpp/src/search.h:32) would livelock: emit_block is
+    all-or-nothing, so the block could never ship. The service clamps."""
+    from fishnet_tpu.search.service import MIN_BATCH_CAPACITY
+
+    svc = SearchService(
+        weights=NnueWeights.random(seed=5),
+        pool_slots=8,
+        batch_capacity=8,  # user asks for less than one block
+        tt_bytes=1 << 20,
+        backend="scalar",
+    )
+    try:
+        assert svc.batch_capacity == MIN_BATCH_CAPACITY
+        res = await svc.search(
+            "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1", [], depth=3
+        )
+        assert res.best_move
+    finally:
+        svc.close()
