@@ -21,10 +21,14 @@ int copy_out(const std::string& s, char* buf, int len) {
   return int(s.size());
 }
 
-// Only standard chess (incl. Chess960) has complete rules so far; other
-// variants are scaffolding and stay gated off until their rule deltas and
-// perft suites land.
-bool variant_supported(int variant) { return variant == VR_STANDARD; }
+// All eight lichess variants are implemented and perft-validated
+// (tests/test_variants.py): standard/Chess960, antichess, atomic,
+// crazyhouse, horde, king-of-the-hill, racing kings, three-check —
+// the same set the reference serves via Fairy-Stockfish
+// (src/logger.rs:192-203).
+bool variant_supported(int variant) {
+  return variant >= VR_STANDARD && variant <= VR_THREE_CHECK;
+}
 
 }  // namespace
 
@@ -128,12 +132,15 @@ NnueNet* fc_nnue_load(const char* path, char* err, int errlen) {
 void fc_nnue_free(NnueNet* net) { delete net; }
 
 int fc_nnue_evaluate(const NnueNet* net, const Position* pos) {
+  if (pos->variant != VR_STANDARD) return INT32_MIN;  // NNUE needs both kings
   return nnue_evaluate(*net, *pos);
 }
 
 // HalfKAv2_hm features of one perspective (0 = side to move, 1 = other).
-// out must hold 32 int32s; returns the active count.
+// out must hold 32 int32s; returns the active count, or -1 for variant
+// positions (HalfKA features are anchored on king squares).
 int fc_pos_features(const Position* pos, int perspective_rel, int32_t* out) {
+  if (pos->variant != VR_STANDARD) return -1;
   Color perspective = perspective_rel == 0 ? pos->stm : ~pos->stm;
   return nnue_features(*pos, perspective, out);
 }

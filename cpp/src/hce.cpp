@@ -1,0 +1,152 @@
+#include "hce.h"
+
+namespace fc {
+namespace {
+
+// Material in centipawns. King value only matters for variants where it
+// is a normal piece (antichess) — elsewhere kings always balance out.
+constexpr int MATERIAL[PIECE_TYPE_NB] = {100, 320, 330, 500, 900, 0};
+
+// Piece-square tables, white's perspective, a1 = index 0. Compact
+// midgame-flavor tables: center control for minors/pawns, king shelter,
+// seventh-rank rooks. Values are small so material dominates.
+constexpr int8_t PST[PIECE_TYPE_NB][64] = {
+    // pawn
+    {0,  0,  0,  0,  0,  0,  0,  0,   5, 10, 10, -20, -20, 10, 10, 5,
+     5, -5, -10, 0,  0, -10, -5, 5,   0,  0,  0,  20,  20,  0,  0, 0,
+     5,  5, 10, 25, 25, 10,  5,  5,  10, 10, 20,  30,  30, 20, 10, 10,
+     50, 50, 50, 50, 50, 50, 50, 50,  0,  0,  0,  0,   0,  0,  0,  0},
+    // knight
+    {-50, -40, -30, -30, -30, -30, -40, -50,  -40, -20, 0,  5,  5,  0, -20, -40,
+     -30, 5,   10,  15,  15,  10,  5,   -30,  -30, 0,  15, 20, 20, 15, 0,   -30,
+     -30, 5,   15,  20,  20,  15,  5,   -30,  -30, 0,  10, 15, 15, 10, 0,   -30,
+     -40, -20, 0,   0,   0,   0,   -20, -40,  -50, -40, -30, -30, -30, -30, -40, -50},
+    // bishop
+    {-20, -10, -10, -10, -10, -10, -10, -20,  -10, 5,  0,  0,  0,  0,  5,  -10,
+     -10, 10,  10,  10,  10,  10,  10,  -10,  -10, 0,  10, 10, 10, 10, 0,  -10,
+     -10, 5,   5,   10,  10,  5,   5,   -10,  -10, 0,  5,  10, 10, 5,  0,  -10,
+     -10, 0,   0,   0,   0,   0,   0,   -10,  -20, -10, -10, -10, -10, -10, -10, -20},
+    // rook
+    {0,  0, 0, 5, 5, 0, 0, 0,   -5, 0, 0, 0, 0, 0, 0, -5,
+     -5, 0, 0, 0, 0, 0, 0, -5,  -5, 0, 0, 0, 0, 0, 0, -5,
+     -5, 0, 0, 0, 0, 0, 0, -5,  -5, 0, 0, 0, 0, 0, 0, -5,
+     5, 10, 10, 10, 10, 10, 10, 5,  0, 0, 0, 0, 0, 0, 0, 0},
+    // queen
+    {-20, -10, -10, -5, -5, -10, -10, -20,  -10, 0,  5,  0,  0,  0,  0,  -10,
+     -10, 5,   5,   5,  5,  5,   0,   -10,  0,   0,  5,  5,  5,  5,  0,  -5,
+     -5,  0,   5,   5,  5,  5,   0,   -5,   -10, 0,  5,  5,  5,  5,  0,  -10,
+     -10, 0,   0,   0,  0,  0,   0,   -10,  -20, -10, -10, -5, -5, -10, -10, -20},
+    // king (shelter-seeking midgame table)
+    {20, 30, 10, 0,  0,  10, 30, 20,   20,  20,  0,   0,   0,   0,   20,  20,
+     -10, -20, -20, -20, -20, -20, -20, -10, -20, -30, -30, -40, -40, -30, -30, -20,
+     -30, -40, -40, -50, -50, -40, -40, -30, -30, -40, -40, -50, -50, -40, -40, -30,
+     -30, -40, -40, -50, -50, -40, -40, -30, -30, -40, -40, -50, -50, -40, -40, -30},
+};
+
+inline Square flip(Square s) { return s ^ 56; }
+
+// Material + PST for one color, white-normalized squares.
+int side_score(const Position& pos, Color c) {
+  int score = 0;
+  for (int pt = PAWN; pt < PIECE_TYPE_NB; pt++) {
+    Bitboard pcs = pos.pieces(c, PieceType(pt));
+    while (pcs) {
+      Square s = pop_lsb(pcs);
+      score += MATERIAL[pt] + PST[pt][c == WHITE ? s : flip(s)];
+    }
+  }
+  return score;
+}
+
+// Chebyshev distance to the four center squares (KotH objective).
+int center_distance(Square s) {
+  int f = file_of(s), r = rank_of(s);
+  int df = f < 3 ? 3 - f : (f > 4 ? f - 4 : 0);
+  int dr = r < 3 ? 3 - r : (r > 4 ? r - 4 : 0);
+  return df > dr ? df : dr;
+}
+
+}  // namespace
+
+int hce_evaluate(const Position& pos) {
+  Color us = pos.stm, them = ~us;
+  int score;
+
+  switch (pos.variant) {
+    case VR_ANTICHESS: {
+      // Objective inverted: shedding material is winning. PSTs would
+      // point the wrong way, so use pure (negated) material with the
+      // king as an ordinary ~300 cp piece, plus a nudge for mobility
+      // freedom (fewer forced captures for us = more control).
+      int mat = 0;
+      for (int pt = PAWN; pt < PIECE_TYPE_NB; pt++) {
+        int v = pt == KING ? 300 : MATERIAL[pt];
+        mat += v * (popcount(pos.pieces(us, PieceType(pt))) -
+                    popcount(pos.pieces(them, PieceType(pt))));
+      }
+      score = -mat;
+      break;
+    }
+    case VR_RACING_KINGS: {
+      // Rank progress dominates; material is a tie-breaker that buys
+      // control of the run.
+      Square uk = pos.king_sq(us), tk = pos.king_sq(them);
+      int progress = (uk != SQ_NONE ? rank_of(uk) : 0) -
+                     (tk != SQ_NONE ? rank_of(tk) : 0);
+      score = 120 * progress + (side_score(pos, us) - side_score(pos, them)) / 4;
+      break;
+    }
+    case VR_KING_OF_THE_HILL: {
+      score = side_score(pos, us) - side_score(pos, them);
+      Square uk = pos.king_sq(us), tk = pos.king_sq(them);
+      if (uk != SQ_NONE) score += 25 * (3 - center_distance(uk));
+      if (tk != SQ_NONE) score -= 25 * (3 - center_distance(tk));
+      break;
+    }
+    case VR_THREE_CHECK:
+      score = side_score(pos, us) - side_score(pos, them);
+      // Each delivered check is worth a minor piece; two checks nearly a
+      // rook — mirroring how sharply the game tilts.
+      score += 250 * (pos.checks_given[us] - pos.checks_given[them]);
+      break;
+    case VR_CRAZYHOUSE: {
+      score = side_score(pos, us) - side_score(pos, them);
+      // Pocket pieces are slightly discounted board material (they need
+      // a tempo to deploy but strike anywhere).
+      for (int pt = PAWN; pt < KING; pt++)
+        score += (MATERIAL[pt] * 3 / 4) *
+                 (pos.hand[us][pt] - pos.hand[them][pt]);
+      break;
+    }
+    case VR_HORDE: {
+      // White's pawns are the army itself: count them at full value via
+      // the shared tables; black wants to trade them off. A small bonus
+      // for advanced horde pawns (promotion pressure) sharpens play.
+      score = side_score(pos, us) - side_score(pos, them);
+      Bitboard horde_pawns = pos.pieces(WHITE, PAWN);
+      int adv = 0;
+      Bitboard p = horde_pawns;
+      while (p) adv += rank_of(pop_lsb(p));
+      score += (us == WHITE ? adv : -adv);
+      break;
+    }
+    case VR_ATOMIC: {
+      score = side_score(pos, us) - side_score(pos, them);
+      // King exposure is lethal: penalize enemy pieces adjacent to our
+      // king (explosion range) far beyond their attack value.
+      Square uk = pos.king_sq(us), tk = pos.king_sq(them);
+      if (uk != SQ_NONE)
+        score -= 40 * popcount(KING_ATTACKS[uk] & pos.pieces(them));
+      if (tk != SQ_NONE)
+        score += 40 * popcount(KING_ATTACKS[tk] & pos.pieces(us));
+      break;
+    }
+    default:
+      score = side_score(pos, us) - side_score(pos, them);
+      break;
+  }
+
+  return score + 10;  // tempo
+}
+
+}  // namespace fc

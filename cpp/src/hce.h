@@ -1,0 +1,24 @@
+// Hand-crafted (classical) evaluation for chess variants.
+//
+// The reference routes all variant analysis and every best-move job to
+// Fairy-Stockfish, whose evaluation for these variants is classical HCE
+// rather than NNUE (reference: src/assets.rs:384-391 maps the
+// MultiVariant flavor to EvalFlavor::Hce, src/queue.rs:530-539 routes
+// variants there). This is the TPU framework's equivalent: a fast scalar
+// centipawn eval with per-variant objective terms, serving the same
+// alpha-beta searcher the NNUE path uses. It stays on the host CPU by
+// design — at ~100 ns/position a device round-trip could never pay for
+// itself, exactly why the reference keeps HCE on CPU too.
+
+#pragma once
+
+#include "position.h"
+
+namespace fc {
+
+// Static evaluation in centipawns from the side to move's perspective.
+// Safe on any variant position, including kingless ones (antichess,
+// horde, exploded atomic kings).
+int hce_evaluate(const Position& pos);
+
+}  // namespace fc

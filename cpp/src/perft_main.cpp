@@ -14,7 +14,19 @@ struct Case {
   const char* fen;
   int depth;
   uint64_t nodes;
+  VariantRules variant = VR_STANDARD;
 };
+
+static VariantRules variant_by_name(const char* name) {
+  if (!strcmp(name, "antichess")) return VR_ANTICHESS;
+  if (!strcmp(name, "atomic")) return VR_ATOMIC;
+  if (!strcmp(name, "crazyhouse")) return VR_CRAZYHOUSE;
+  if (!strcmp(name, "horde")) return VR_HORDE;
+  if (!strcmp(name, "kingofthehill")) return VR_KING_OF_THE_HILL;
+  if (!strcmp(name, "racingkings")) return VR_RACING_KINGS;
+  if (!strcmp(name, "3check")) return VR_THREE_CHECK;
+  return VR_STANDARD;
+}
 
 // Standard perft suite (positions and counts are community-standard test
 // vectors, e.g. from the chessprogramming wiki perft results page).
@@ -32,6 +44,23 @@ static const Case SUITE[] = {
     {"pos6 d4",
      "r4rk1/1pp1qppp/p1np1n2/2b1p1B1/2B1P1b1/P1NP1N2/1PP1QPPP/R4RK1 w - - 0 10", 4,
      3894594ULL},
+    // Variant start positions; expected counts are Fairy-Stockfish's
+    // published perft test vectors for the matching lichess rules.
+    {"antichess d5", "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w - - 0 1", 5,
+     2732672ULL, VR_ANTICHESS},
+    {"atomic d5", "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1", 5,
+     4864979ULL, VR_ATOMIC},
+    {"crazyhouse d5", "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR[] w KQkq - 0 1", 5,
+     4888832ULL, VR_CRAZYHOUSE},
+    {"horde d6",
+     "rnbqkbnr/pppppppp/8/1PP2PP1/PPPPPPPP/PPPPPPPP/PPPPPPPP/PPPPPPPP w kq - 0 1", 6,
+     5396554ULL, VR_HORDE},
+    {"racingkings d5", "8/8/8/8/8/8/krbnNBRK/qrbnNBRQ w - - 0 1", 5, 9472927ULL,
+     VR_RACING_KINGS},
+    {"3check d5", "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 3+3 0 1", 5,
+     4865609ULL, VR_THREE_CHECK},
+    {"koth d5", "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1", 5,
+     4865609ULL, VR_KING_OF_THE_HILL},
 };
 
 int main(int argc, char** argv) {
@@ -42,8 +71,9 @@ int main(int argc, char** argv) {
     int depth = atoi(argv[1]);
     const char* fen = argc >= 3 ? argv[2]
                                 : "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1";
+    VariantRules var = argc >= 4 ? variant_by_name(argv[3]) : VR_STANDARD;
     Position pos;
-    std::string err = pos.set_fen(fen, VR_STANDARD);
+    std::string err = pos.set_fen(fen, var);
     if (!err.empty()) {
       fprintf(stderr, "bad fen: %s\n", err.c_str());
       return 1;
@@ -59,7 +89,7 @@ int main(int argc, char** argv) {
   int failures = 0;
   for (const Case& c : SUITE) {
     Position pos;
-    std::string err = pos.set_fen(c.fen, VR_STANDARD);
+    std::string err = pos.set_fen(c.fen, c.variant);
     if (!err.empty()) {
       printf("FAIL %-12s bad fen: %s\n", c.name, err.c_str());
       failures++;

@@ -115,6 +115,7 @@ struct SearchPool {
   TranspositionTable tt;
   std::unique_ptr<NnueNet> scalar_net;
   std::unique_ptr<ScalarEval> scalar_eval;
+  HceEval hce_eval;  // variant searches (immediate, CPU)
   std::vector<std::unique_ptr<Slot>> slots;
   // (slot id, index within the slot's block) per entry of the last
   // step()'s eval batch, in emission order.
@@ -158,10 +159,13 @@ SearchPool* fc_pool_new(int max_slots, uint64_t tt_bytes,
 void fc_pool_free(SearchPool* pool) { delete pool; }
 
 // Submit a search. moves: space-separated UCI from the root fen (the game
-// line, for history/repetitions). Returns the slot id, or -1 if the pool
-// is full / input invalid.
+// line, for history/repetitions). variant: a VariantRules value;
+// non-standard variants are evaluated with the classical HCE on the host
+// (the reference's MultiVariant flavor) and never suspend for the device.
+// Returns the slot id, or -1 if the pool is full / input invalid.
 int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
-                   uint64_t nodes, int depth, int multipv, int use_scalar) {
+                   uint64_t nodes, int depth, int multipv, int use_scalar,
+                   int variant) {
   int id = -1;
   for (size_t i = 0; i < pool->slots.size(); i++)
     if (!pool->slots[i]->active) {
@@ -171,8 +175,9 @@ int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
   if (id < 0) return -1;
   Slot& slot = *pool->slots[id];
 
+  if (variant < VR_STANDARD || variant > VR_THREE_CHECK) return -2;
   Position pos;
-  if (!pos.set_fen(fen ? fen : "", VR_STANDARD).empty()) return -2;
+  if (!pos.set_fen(fen ? fen : "", VariantRules(variant)).empty()) return -2;
   slot.history.clear();
   slot.history.push_back(pos.hash);
   if (moves && moves[0]) {
@@ -275,9 +280,12 @@ int fc_pool_step(SearchPool* pool, uint16_t* out_features, int32_t* out_buckets,
       slot.started = true;
       Slot* sp = &slot;
       SearchPool* pp = pool;
-      EvalBridge* eval = slot.use_scalar
-                             ? static_cast<EvalBridge*>(pp->scalar_eval.get())
-                             : static_cast<EvalBridge*>(slot.bridge.get());
+      EvalBridge* eval =
+          slot.root.variant != VR_STANDARD
+              ? static_cast<EvalBridge*>(&pp->hce_eval)
+          : slot.use_scalar
+              ? static_cast<EvalBridge*>(pp->scalar_eval.get())
+              : static_cast<EvalBridge*>(slot.bridge.get());
       slot.search = std::make_unique<Search>(&pp->tt, eval);
       slot.fiber->start([sp] {
         sp->result = sp->search->run(sp->root, sp->history, sp->limits);

@@ -17,6 +17,10 @@ uint64_t ep_file[8];
 uint64_t black_to_move;
 uint64_t checks[COLOR_NB][4];
 uint64_t hand_piece[COLOR_NB][PIECE_TYPE_NB][17];
+uint64_t promoted_sq[64];
+// Per-variant keys: the same FEN under different rules (or a different
+// eval family) must never collide in the shared transposition table.
+uint64_t variant_key[8];
 }  // namespace zobrist
 
 static uint64_t splitmix64(uint64_t& x) {
@@ -42,6 +46,10 @@ void init_zobrist() {
   for (auto& c : zobrist::hand_piece)
     for (auto& p : c)
       for (auto& v : p) v = splitmix64(seed);
+  for (auto& v : zobrist::promoted_sq) v = splitmix64(seed);
+  zobrist::variant_key[VR_STANDARD] = 0;  // identity: standard hashes unchanged
+  for (int v = VR_STANDARD + 1; v <= VR_THREE_CHECK; v++)
+    zobrist::variant_key[v] = splitmix64(seed);
 }
 
 // ---------------------------------------------------------------------------
@@ -85,6 +93,9 @@ uint64_t Position::compute_hash() const {
     for (int pt = PAWN; pt < PIECE_TYPE_NB; pt++)
       if (hand[c][pt]) h ^= zobrist::hand_piece[c][pt][hand[c][pt]];
   }
+  Bitboard promo = promoted;
+  while (promo) h ^= zobrist::promoted_sq[pop_lsb(promo)];
+  h ^= zobrist::variant_key[variant];
   return h;
 }
 
@@ -121,12 +132,51 @@ std::string Position::set_fen(const std::string& fen, VariantRules var) {
   memset(board, NO_PIECE, sizeof(board));
 
   std::istringstream ss(fen);
-  std::string placement, turn, castling, ep, half, full;
-  ss >> placement >> turn >> castling >> ep >> half >> full;
+  std::string placement, turn, castling, ep;
+  ss >> placement >> turn >> castling >> ep;
   if (placement.empty()) return "empty FEN";
   if (turn.empty()) turn = "w";
   if (castling.empty()) castling = "-";
   if (ep.empty()) ep = "-";
+
+  // Remaining fields: halfmove, fullmove, and (three-check) a check-count
+  // token that different producers place either between ep and halfmove
+  // (X-FEN/shakmaty "3+3" = checks remaining) or trailing ("+0+0" =
+  // checks given, legacy lichess). Scan flexibly: any token containing
+  // '+' is a check field, plain integers fill halfmove then fullmove.
+  std::string half, full, checks_tok;
+  {
+    std::string tok;
+    int ints_seen = 0;
+    while (ss >> tok) {
+      if (tok.find('+') != std::string::npos) {
+        checks_tok = tok;
+      } else if (ints_seen == 0) {
+        half = tok;
+        ints_seen++;
+      } else if (ints_seen == 1) {
+        full = tok;
+        ints_seen++;
+      }
+    }
+  }
+  if (!checks_tok.empty()) {
+    int a = -1, b = -1;
+    bool given = checks_tok[0] == '+';  // "+w+b" counts checks delivered
+    if (given && checks_tok.size() == 4 && isdigit(checks_tok[1]) &&
+        checks_tok[2] == '+' && isdigit(checks_tok[3])) {
+      a = checks_tok[1] - '0';
+      b = checks_tok[3] - '0';
+    } else if (!given && checks_tok.size() == 3 && isdigit(checks_tok[0]) &&
+               checks_tok[1] == '+' && isdigit(checks_tok[2])) {
+      // remaining checks -> delivered = 3 - remaining
+      a = 3 - (checks_tok[0] - '0');
+      b = 3 - (checks_tok[2] - '0');
+    }
+    if (a < 0 || a > 3 || b < 0 || b > 3) return "bad check-count field";
+    checks_given[WHITE] = uint8_t(a);
+    checks_given[BLACK] = uint8_t(b);
+  }
 
   // Piece placement. Lichess crazyhouse FENs may carry a pocket either as
   // an extra rank ("...8/PPPP[QRq]") or bracket suffix; accept "[...]".
@@ -151,10 +201,10 @@ std::string Position::set_fen(const std::string& fen, VariantRules var) {
       file += c - '0';
       if (file > 8) return "bad file count";
     } else if (c == '~') {
-      // promoted-piece marker (crazyhouse): piece already placed; record
-      // nothing for now (promoted pieces drop back as pawns — tracked when
-      // crazyhouse rules land).
+      // promoted-piece marker (crazyhouse): applies to the piece just
+      // placed; it drops back into the pocket as a pawn when captured.
       if (file == 0) return "misplaced ~";
+      promoted |= bb(make_square(file - 1, rank));
     } else {
       int pc = piece_from_char(c);
       if (pc == NO_PIECE || file > 7 || rank < 0) return "bad piece placement";
@@ -219,10 +269,13 @@ std::string Position::set_fen(const std::string& fen, VariantRules var) {
   if (variant != VR_ANTICHESS && variant != VR_HORDE) {
     if (popcount(pieces(WHITE, KING)) != 1 || popcount(pieces(BLACK, KING)) != 1)
       return "kings missing";
-    // Side not to move must not be in check (illegal position).
-    Square k = king_sq(~stm);
-    if (k != SQ_NONE && attacked_by(k, stm, occupied()))
-      return "side not to move is in check";
+    // Side not to move must not be in check (illegal position) — except
+    // in atomic, where adjacent kings annul all checks.
+    if (!(variant == VR_ATOMIC && kings_adjacent())) {
+      Square k = king_sq(~stm);
+      if (k != SQ_NONE && attacked_by(k, stm, occupied()))
+        return "side not to move is in check";
+    }
   } else if (variant == VR_HORDE) {
     if (popcount(pieces(BLACK, KING)) != 1) return "kings missing";
   }
@@ -243,6 +296,7 @@ std::string Position::fen() const {
         if (run) out << run;
         run = 0;
         out << PIECE_CHARS[pc];
+        if (promoted & bb(make_square(f, r))) out << '~';
       }
     }
     if (run) out << run;
@@ -294,8 +348,9 @@ std::string Position::fen() const {
   out << ' ' << (ep_square == SQ_NONE ? "-" : square_name(ep_square));
 
   if (variant == VR_THREE_CHECK)
-    // Lichess three-check FEN carries remaining checks as "+W+B".
-    out << ' ' << '+' << (3 - checks_given[WHITE]) << '+' << (3 - checks_given[BLACK]);
+    // X-FEN / shakmaty style: checks *remaining* as "W+B" between the
+    // en-passant and halfmove fields (set_fen also accepts legacy "+w+b").
+    out << ' ' << (3 - checks_given[WHITE]) << '+' << (3 - checks_given[BLACK]);
 
   out << ' ' << halfmove << ' ' << fullmove;
   return out.str();
@@ -361,6 +416,10 @@ void Position::gen_pseudo(MoveList& out) const {
 
   Bitboard single = pawn_pushes(us, non7, ~occ);
   Bitboard dbl = pawn_pushes(us, single & rank3, ~occ);
+  // Horde: white pawns on the first rank may also advance two squares
+  // (lichess horde rule; only white has back-rank pawns).
+  if (variant == VR_HORDE && us == WHITE)
+    dbl |= pawn_pushes(us, single & rank_bb(1), ~occ);
   Bitboard tmp = single;
   while (tmp) {
     Square to = pop_lsb(tmp);
@@ -408,11 +467,14 @@ void Position::gen_pseudo(MoveList& out) const {
         default: att = KING_ATTACKS[from]; break;
       }
       att &= targets;
+      // Atomic: kings may never capture (the explosion would take the
+      // capturing king with it).
+      if (variant == VR_ATOMIC && pt == KING) att &= ~by_color[them];
       while (att) out.push(make_move(from, pop_lsb(att)));
     }
   }
 
-  if (variant != VR_ANTICHESS && !in_check()) gen_castling(out);
+  if (variant != VR_ANTICHESS && !effective_check()) gen_castling(out);
 
   // Crazyhouse drops.
   if (variant == VR_CRAZYHOUSE) {
@@ -429,13 +491,25 @@ void Position::gen_pseudo(MoveList& out) const {
 
 bool Position::is_legal(Move m) const {
   // Antichess has no check rules; every generated move is legal (the
-  // capture obligation is enforced during generation).
+  // capture obligation is enforced in legal_moves).
   if (variant == VR_ANTICHESS) return true;
   Position copy = *this;
   copy.make(m);
+  if (variant == VR_ATOMIC) {
+    // Exploding your own king is illegal; exploding the enemy king wins
+    // regardless of check; adjacent kings annul check entirely.
+    if (!copy.pieces(stm, KING)) return false;
+    if (!copy.pieces(~stm, KING)) return true;
+    if (copy.kings_adjacent()) return true;
+    Square k = copy.king_sq(stm);
+    return !copy.attacked_by(k, copy.stm, copy.occupied());
+  }
   Square k = copy.king_sq(stm);
-  if (k == SQ_NONE) return variant == VR_ANTICHESS || variant == VR_HORDE;
-  return !copy.attacked_by(k, copy.stm, copy.occupied());
+  if (k == SQ_NONE) return variant == VR_HORDE;
+  if (copy.attacked_by(k, copy.stm, copy.occupied())) return false;
+  // Racing kings: delivering check is forbidden.
+  if (variant == VR_RACING_KINGS && copy.in_check()) return false;
+  return true;
 }
 
 void Position::legal_moves(MoveList& out) const {
@@ -443,6 +517,27 @@ void Position::legal_moves(MoveList& out) const {
   gen_pseudo(pseudo);
   for (Move m : pseudo)
     if (is_legal(m)) out.push(m);
+  // Antichess capture obligation: if any capture is available, only
+  // captures are legal.
+  if (variant == VR_ANTICHESS) {
+    bool have_capture = false;
+    for (int i = 0; i < out.size; i++) {
+      Move m = out.moves[i];
+      if (move_kind(m) == MK_EN_PASSANT || !empty(move_to(m))) {
+        have_capture = true;
+        break;
+      }
+    }
+    if (have_capture) {
+      int n = 0;
+      for (int i = 0; i < out.size; i++) {
+        Move m = out.moves[i];
+        if (move_kind(m) == MK_EN_PASSANT || !empty(move_to(m)))
+          out.moves[n++] = m;
+      }
+      out.size = n;
+    }
+  }
 }
 
 bool Position::ep_capture_legal() const {
@@ -505,19 +600,30 @@ void Position::make(Move m) {
       Square from = move_from(m), to = move_to(m);
       int moving = board[from];
       PieceType mpt = piece_type(moving);
+      bool was_capture = move_kind(m) == MK_EN_PASSANT || !empty(to);
 
       if (move_kind(m) == MK_EN_PASSANT) {
         remove_piece(to - up);  // the double-pushed enemy pawn
         halfmove = 0;
+        if (variant == VR_CRAZYHOUSE) {
+          if (hand[us][PAWN]) hash ^= zobrist::hand_piece[us][PAWN][hand[us][PAWN]];
+          hand[us][PAWN]++;
+          hash ^= zobrist::hand_piece[us][PAWN][hand[us][PAWN]];
+        }
       } else if (!empty(to)) {
         // Capture: clear rights if a castling rook is taken; pocket it in
-        // crazyhouse.
+        // crazyhouse (promoted pieces demote back to pawns).
         if (castling_rooks & bb(to)) {
           castling_rooks &= ~bb(to);
           hash ^= zobrist::castling_rook[to];
         }
         if (variant == VR_CRAZYHOUSE) {
           PieceType cap = piece_type(board[to]);
+          if (promoted & bb(to)) {
+            cap = PAWN;
+            promoted &= ~bb(to);
+            hash ^= zobrist::promoted_sq[to];
+          }
           if (hand[us][cap]) hash ^= zobrist::hand_piece[us][cap][hand[us][cap]];
           hand[us][cap]++;
           hash ^= zobrist::hand_piece[us][cap][hand[us][cap]];
@@ -532,10 +638,41 @@ void Position::make(Move m) {
       else
         put_piece(to, moving);
 
+      if (variant == VR_CRAZYHOUSE) {
+        // Track promoted status: it travels with the piece and is set on
+        // promotion; captured promoted pieces were demoted above.
+        if (promoted & bb(from)) {
+          promoted &= ~bb(from);
+          hash ^= zobrist::promoted_sq[from];
+          promoted |= bb(to);
+          hash ^= zobrist::promoted_sq[to];
+        }
+        if (move_promo(m) != NO_PIECE_TYPE && !(promoted & bb(to))) {
+          promoted |= bb(to);
+          hash ^= zobrist::promoted_sq[to];
+        }
+      }
+
+      if (variant == VR_ATOMIC && was_capture) {
+        // Explosion: the capturer vanishes along with every non-pawn
+        // piece adjacent to the capture square.
+        remove_piece(to);
+        Bitboard blast = KING_ATTACKS[to] & occupied() & ~by_type[PAWN];
+        while (blast) {
+          Square s = pop_lsb(blast);
+          if (castling_rooks & bb(s)) {
+            castling_rooks &= ~bb(s);
+            hash ^= zobrist::castling_rook[s];
+          }
+          remove_piece(s);
+        }
+      }
+
       if (mpt == PAWN) {
         halfmove = 0;
-        if (to - from == 2 * up) {
+        if (to - from == 2 * up && rank_of(from) == (us == WHITE ? 1 : 6)) {
           // Tentatively set ep; keep only if a legal capture exists.
+          // (Horde first-rank double pushes grant no en-passant rights.)
           ep_square = from + up;
         }
       } else if (mpt == KING) {
@@ -629,18 +766,24 @@ int Position::outcome() const {
 
   if (variant == VR_THREE_CHECK && checks_given[~stm] >= 3) return 3;
   if (variant == VR_KING_OF_THE_HILL) {
-    Bitboard center = bb(make_square(3, 3)) | bb(make_square(4, 3)) |
-                      bb(make_square(3, 4)) | bb(make_square(4, 4));
-    if (pieces(~stm, KING) & center) return 3;
+    if (pieces(~stm, KING) & CENTER4_BB) return 3;
   }
   if (variant == VR_RACING_KINGS) {
     bool they_reached = pieces(~stm, KING) & rank_bb(7);
+    bool we_reached = pieces(stm, KING) & rank_bb(7);
+    if (they_reached && we_reached) return 5;  // both finished: draw
     if (they_reached) {
-      // Black gets one extra move to equalize; simplified: if our king can
-      // also reach rank 8 it's a draw — full rule handled at game level.
-      bool we_reached = pieces(stm, KING) & rank_bb(7);
-      return we_reached ? 5 : 3;
+      // White moves first, so when white finishes black gets one reply
+      // to equalize; the game continues if black can still reach rank 8.
+      if (stm == BLACK) {
+        for (Move m : legal)
+          if (piece_type(board[move_from(m)]) == KING && rank_of(move_to(m)) == 7)
+            return 0;
+      }
+      return 3;
     }
+    // We finished earlier and the opponent's equalizing reply failed.
+    if (we_reached) return 4;
   }
   if (variant == VR_HORDE && !pieces(WHITE)) return stm == WHITE ? 3 : 4;
   if (variant == VR_ATOMIC) {
@@ -650,7 +793,7 @@ int Position::outcome() const {
 
   if (legal.size == 0) {
     if (variant == VR_ANTICHESS) return 4;  // no moves = win in antichess
-    if (in_check()) return 1;               // checkmate
+    if (effective_check()) return 1;        // checkmate
     if (variant == VR_HORDE && stm == WHITE && !pieces(WHITE)) return 3;
     return 2;  // stalemate
   }
@@ -675,6 +818,51 @@ int Position::outcome() const {
   }
 
   return 0;
+}
+
+bool Position::variant_terminal(int& res) const {
+  switch (variant) {
+    case VR_THREE_CHECK:
+      if (checks_given[~stm] >= 3) { res = -1; return true; }
+      if (checks_given[stm] >= 3) { res = +1; return true; }
+      return false;
+    case VR_KING_OF_THE_HILL:
+      if (pieces(~stm, KING) & CENTER4_BB) { res = -1; return true; }
+      if (pieces(stm, KING) & CENTER4_BB) { res = +1; return true; }
+      return false;
+    case VR_ATOMIC:
+      if (!pieces(stm, KING)) { res = -1; return true; }
+      if (!pieces(~stm, KING)) { res = +1; return true; }
+      return false;
+    case VR_HORDE:
+      if (!pieces(WHITE)) { res = stm == WHITE ? -1 : +1; return true; }
+      return false;
+    case VR_RACING_KINGS: {
+      bool they = pieces(~stm, KING) & rank_bb(7);
+      bool we = pieces(stm, KING) & rank_bb(7);
+      if (they && we) { res = 0; return true; }
+      if (they) {
+        // Black's one-move equalizing chance: only terminal if the black
+        // king cannot even pseudo-reach rank 8 (conservative — if it can,
+        // the search resolves the reply with real moves).
+        if (stm == BLACK) {
+          Square k = king_sq(BLACK);
+          if (k != SQ_NONE && (KING_ATTACKS[k] & rank_bb(7) & ~pieces(BLACK)))
+            return false;
+        }
+        res = -1;
+        return true;
+      }
+      if (we) { res = +1; return true; }
+      return false;
+    }
+    case VR_ANTICHESS:
+      if (!pieces(stm)) { res = +1; return true; }
+      if (!pieces(~stm)) { res = -1; return true; }
+      return false;
+    default:
+      return false;
+  }
 }
 
 // ---------------------------------------------------------------------------

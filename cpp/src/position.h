@@ -47,6 +47,7 @@ struct Position {
   VariantRules variant = VR_STANDARD;
   uint8_t checks_given[COLOR_NB] = {0, 0};      // three-check
   uint8_t hand[COLOR_NB][PIECE_TYPE_NB] = {};   // crazyhouse pockets
+  Bitboard promoted = 0;                        // crazyhouse: promoted pieces
 
   // -- accessors --------------------------------------------------------
   Bitboard occupied() const { return by_color[WHITE] | by_color[BLACK]; }
@@ -70,6 +71,20 @@ struct Position {
     return k == SQ_NONE ? 0 : attackers_to(k, occupied()) & by_color[~stm];
   }
   bool in_check() const { return checkers() != 0; }
+  bool kings_adjacent() const {
+    Bitboard wk = pieces(WHITE, KING), bk = pieces(BLACK, KING);
+    return wk && bk && (KING_ATTACKS[lsb(wk)] & bk);
+  }
+  // Check for rules purposes. In atomic chess adjacent kings annul check
+  // (capturing the king would explode the capturer's own king).
+  bool effective_check() const {
+    if (variant == VR_ATOMIC && kings_adjacent()) return false;
+    return in_check();
+  }
+  // Variant-terminal test that needs no move generation, usable at every
+  // search node. Returns true when the game is over by variant rule;
+  // res = +1 win for stm, -1 loss for stm, 0 draw.
+  bool variant_terminal(int& res) const;
 
   // -- setup ------------------------------------------------------------
   // Returns empty string on success, error message otherwise.

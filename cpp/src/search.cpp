@@ -221,17 +221,36 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
     stopped_ = true;
   if (stopped_ || ply >= MAX_PLY) return evaluate(pos);
 
-  bool in_check = pos.in_check();
+  if (pos.variant != VR_STANDARD) {
+    int vres;
+    if (pos.variant_terminal(vres))
+      return vres > 0 ? VALUE_MATE - ply
+                      : vres < 0 ? -(VALUE_MATE - ply) : VALUE_DRAW;
+  }
+
+  bool in_check = pos.effective_check();
 
   // Moves first: detects mate/stalemate before spending an eval, and the
   // list feeds both the stand-pat prefetch and the capture loop below.
   MoveList moves;
   pos.legal_moves(moves);
-  if (moves.size == 0) return in_check ? -(VALUE_MATE - ply) : VALUE_DRAW;
+  if (moves.size == 0) {
+    if (pos.variant == VR_ANTICHESS) return VALUE_MATE - ply;  // no moves: win
+    return in_check ? -(VALUE_MATE - ply) : VALUE_DRAW;
+  }
+
+  // Antichess: when any capture exists, every legal move IS a capture
+  // (the obligation is enforced in legal_moves) — the mover cannot
+  // decline, so stand-pat is not a valid lower bound; search every move
+  // exactly like check evasions.
+  bool forced_captures =
+      pos.variant == VR_ANTICHESS && moves.size > 0 &&
+      (!pos.empty(move_to(moves.moves[0])) ||
+       move_kind(moves.moves[0]) == MK_EN_PASSANT);
 
   int best = -VALUE_INF;
 
-  if (in_check) {
+  if (in_check || forced_captures) {
     // Every evasion is searched below and most land in quiet positions
     // needing a stand-pat eval: fetch them all in one round-trip.
     // (Only worthwhile when evals actually batch; the scalar eval would
@@ -259,9 +278,10 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
     best = stand;
   }
 
-  // In check: search every evasion. Otherwise captures/promotions only.
+  // In check (or under the antichess capture obligation): search every
+  // move. Otherwise captures/promotions only.
   MoveList targets;
-  if (in_check) {
+  if (in_check || forced_captures) {
     targets = moves;
   } else {
     for (Move m : moves)
@@ -294,7 +314,14 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
   if (ply > 0 && is_repetition_or_50(pos, ply)) return VALUE_DRAW;
   if (ply >= MAX_PLY) return evaluate(pos);
 
-  bool in_check = pos.in_check();
+  if (pos.variant != VR_STANDARD) {
+    int vres;
+    if (pos.variant_terminal(vres))
+      return vres > 0 ? VALUE_MATE - ply
+                      : vres < 0 ? -(VALUE_MATE - ply) : VALUE_DRAW;
+  }
+
+  bool in_check = pos.effective_check();
   if (in_check) depth++;  // check extension
 
   if (depth <= 0) return qsearch(pos, alpha, beta, ply);
@@ -326,7 +353,7 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
   // Null-move pruning: skip a turn; if we still beat beta at reduced
   // depth, the node is almost certainly a fail-high. Requires non-pawn
   // material to avoid zugzwang traps.
-  if (!is_pv && !in_check && depth >= 3 && ply > 0 &&
+  if (!is_pv && !in_check && depth >= 3 && ply > 0 && pos.variant != VR_ANTICHESS &&
       (pos.pieces(pos.stm) & ~(pos.pieces(pos.stm, PAWN) | pos.pieces(pos.stm, KING)))) {
     Position copy = pos;
     copy.make_null();
@@ -339,7 +366,10 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
 
   MoveList moves;
   pos.legal_moves(moves);
-  if (moves.size == 0) return in_check ? -(VALUE_MATE - ply) : VALUE_DRAW;
+  if (moves.size == 0) {
+    if (pos.variant == VR_ANTICHESS) return VALUE_MATE - ply;  // no moves: win
+    return in_check ? -(VALUE_MATE - ply) : VALUE_DRAW;
+  }
 
   order_moves(pos, moves, tt_move, ply);
 

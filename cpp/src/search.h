@@ -14,6 +14,7 @@
 #include <string>
 #include <vector>
 
+#include "hce.h"
 #include "nnue.h"
 #include "position.h"
 
@@ -58,6 +59,13 @@ class ScalarEval : public EvalBridge {
 
  private:
   const NnueNet* net_;
+};
+
+// Classical eval for variant searches (the reference's MultiVariant/HCE
+// flavor, src/assets.rs:384-391). Immediate — never suspends a fiber.
+class HceEval : public EvalBridge {
+ public:
+  int evaluate(const Position& pos) override { return hce_evaluate(pos); }
 };
 
 // -- transposition table (shared across all searches; the scheduler is

@@ -55,6 +55,11 @@ constexpr Bitboard RANK_1_BB = 0xFFULL;
 constexpr Bitboard file_bb(int f) { return FILE_A_BB << f; }
 constexpr Bitboard rank_bb(int r) { return RANK_1_BB << (8 * r); }
 
+// The four central squares d4/e4/d5/e5 — the king-of-the-hill objective,
+// shared by outcome detection, search terminals, and the HCE eval.
+constexpr Bitboard CENTER4_BB = bb(make_square(3, 3)) | bb(make_square(4, 3)) |
+                                bb(make_square(3, 4)) | bb(make_square(4, 4));
+
 inline int popcount(Bitboard b) { return __builtin_popcountll(b); }
 inline Square lsb(Bitboard b) { return __builtin_ctzll(b); }
 inline Square msb(Bitboard b) { return 63 - __builtin_clzll(b); }

@@ -149,5 +149,9 @@ class Board:
         for perspective in (0, 1):
             buf = (ctypes.c_int32 * 32)()
             n = self._lib.fc_pos_features(self._pos, perspective, buf)
+            if n < 0:
+                raise UnsupportedVariantError(
+                    "HalfKAv2_hm features are defined for standard chess only"
+                )
             out[perspective, :n] = np.frombuffer(buf, dtype=np.int32, count=n)
         return out, self._lib.fc_pos_psqt_bucket(self._pos)

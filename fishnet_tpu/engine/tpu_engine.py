@@ -74,6 +74,7 @@ class TpuNnueEngine(Engine):
                 depth=depth,
                 multipv=multipv,
                 movetime_seconds=movetime,
+                variant=position.variant,
             )
         except EngineError:
             raise

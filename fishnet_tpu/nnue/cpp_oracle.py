@@ -6,7 +6,7 @@ import ctypes
 from pathlib import Path
 from typing import Union
 
-from fishnet_tpu.chess.board import Board
+from fishnet_tpu.chess.board import Board, UnsupportedVariantError
 from fishnet_tpu.chess.core import NativeCoreError, load
 
 
@@ -28,4 +28,9 @@ class CppNnue:
 
     def evaluate(self, board: Board) -> int:
         """Centipawn score from the side to move's point of view."""
-        return self._lib.fc_nnue_evaluate(self._net, board._pos)
+        value = self._lib.fc_nnue_evaluate(self._net, board._pos)
+        if value == -(2**31):  # sentinel: variant position, NNUE undefined
+            raise UnsupportedVariantError(
+                "NNUE evaluation is defined for standard chess only"
+            )
+        return value

@@ -24,7 +24,9 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from fishnet_tpu.chess.board import _VARIANT_CODES
 from fishnet_tpu.chess.core import NativeCoreError, load
+from fishnet_tpu.protocol.types import Variant
 from fishnet_tpu.nnue import spec
 from fishnet_tpu.nnue.weights import NnueWeights
 
@@ -63,6 +65,7 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib.fc_pool_submit.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int,
     ]
     lib.fc_pool_submit.restype = ctypes.c_int
     lib.fc_pool_stop.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -192,6 +195,7 @@ class SearchService:
         depth: int = 0,
         multipv: int = 1,
         movetime_seconds: Optional[float] = None,
+        variant: Variant = Variant.STANDARD,
     ) -> SearchResultData:
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
@@ -200,7 +204,7 @@ class SearchService:
                 raise NativeCoreError("search service is shut down")
             self._submissions.append(
                 (root_fen, " ".join(moves), nodes, depth, multipv, future, loop,
-                 movetime_seconds)
+                 movetime_seconds, variant)
             )
         self._wake.set()
         return await future
@@ -316,11 +320,13 @@ class SearchService:
             with self._lock:
                 submissions, self._submissions = self._submissions, []
             for item in submissions:
-                fen, moves, nodes, depth, multipv, future, loop, movetime = item
+                (fen, moves, nodes, depth, multipv, future, loop, movetime,
+                 variant) = item
                 use_scalar = 1 if self.backend == "scalar" else 0
                 slot = lib.fc_pool_submit(
                     self._pool, fen.encode(), moves.encode(),
                     nodes, depth, multipv, use_scalar,
+                    _VARIANT_CODES[variant],
                 )
                 if slot == -1:
                     # Pool momentarily full: requeue; a slot frees up once

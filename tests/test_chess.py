@@ -155,9 +155,14 @@ def test_invalid_fen():
         Board("rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBN w KQkq - 0 1")
 
 
-def test_unsupported_variant_gated():
-    with pytest.raises(UnsupportedVariantError):
-        Board(variant=Variant.ATOMIC)
+def test_all_variants_ungated():
+    # Every lichess variant the reference serves via Fairy-Stockfish is
+    # rules-complete in the native core (perft suite: tests/test_variants.py).
+    standard = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+    racing = "8/8/8/8/8/8/krbnNBRK/qrbnNBRQ w - - 0 1"
+    for variant in Variant:
+        fen = racing if variant is Variant.RACING_KINGS else standard
+        assert Board(fen, variant).legal_moves()
 
 
 def test_zobrist_transposition():

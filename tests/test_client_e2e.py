@@ -192,14 +192,20 @@ async def test_key_check():
         await asyncio.wait_for(task2, 5)
 
 
-async def test_variant_batch_routed_hce_or_ignored():
-    # Variants aren't implemented in the native core yet: the batch must be
-    # ignored cleanly (invalid-batch path), not crash the client.
+async def test_variant_batch_analyzed_with_hce_flavor():
+    # Variant batches route to the MULTI_VARIANT flavor (HCE eval, like the
+    # reference's Fairy-Stockfish tier) and complete alongside standard work.
     async with FakeServer() as server:
-        bad = server.lichess.add_analysis_job(moves="e2e4", variant="atomic")
-        good = server.lichess.add_analysis_job(moves="e2e4")
-        client = make_client(server.endpoint, cores=1)
+        variant_job = server.lichess.add_analysis_job(moves="e2e4", variant="atomic")
+        standard_job = server.lichess.add_analysis_job(moves="e2e4")
+        client = make_client(server.endpoint, cores=2)
         await client.start()
-        assert await wait_for(lambda: good in server.lichess.analyses)
+        assert await wait_for(
+            lambda: variant_job in server.lichess.analyses
+            and standard_job in server.lichess.analyses
+        )
         await client.stop()
-        assert bad not in server.lichess.analyses
+        assert server.lichess.analyses[variant_job]["stockfish"]["flavor"] == "classical"
+        assert server.lichess.analyses[standard_job]["stockfish"]["flavor"] == "nnue"
+        plies = server.lichess.analyses[variant_job]["analysis"]
+        assert all("pv" in p for p in plies)

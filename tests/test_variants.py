@@ -1,0 +1,285 @@
+"""Variant rules (the reference's Fairy-Stockfish tier, src/logger.rs:192-203,
+src/queue.rs:530-539): perft validation against Fairy-Stockfish's published
+vectors, per-variant rule deltas, FEN round-trips, and batched variant
+searches through the SearchService (HCE eval on the host)."""
+
+import pytest
+
+from fishnet_tpu.chess.board import Board, variant_supported
+from fishnet_tpu.nnue.weights import NnueWeights
+from fishnet_tpu.protocol.types import Variant
+from fishnet_tpu.search.service import SearchService
+
+STARTPOS = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+HORDE_START = "rnbqkbnr/pppppppp/8/1PP2PP1/PPPPPPPP/PPPPPPPP/PPPPPPPP/PPPPPPPP w kq - 0 1"
+RK_START = "8/8/8/8/8/8/krbnNBRK/qrbnNBRQ w - - 0 1"
+
+
+def test_all_variants_supported():
+    for v in Variant:
+        assert variant_supported(v), v
+
+
+# -- perft (depths kept modest; the full d5/d6 suite runs in cpp/perft) ----
+
+PERFTS = [
+    (Variant.ANTICHESS, STARTPOS.replace(" KQkq", " -"), 4, 153299),
+    (Variant.ATOMIC, STARTPOS, 4, 197326),
+    (Variant.CRAZYHOUSE, STARTPOS.replace("NR w", "NR[] w"), 4, 197281),
+    (Variant.HORDE, HORDE_START, 5, 265223),
+    (Variant.RACING_KINGS, RK_START, 4, 296242),
+    (Variant.THREE_CHECK, STARTPOS + " +0+0", 4, 197281),
+    (Variant.KING_OF_THE_HILL, STARTPOS, 4, 197281),
+]
+
+
+@pytest.mark.parametrize("variant,fen,depth,expected", PERFTS,
+                         ids=[p[0].value for p in PERFTS])
+def test_variant_perft(variant, fen, depth, expected):
+    assert Board(fen, variant).perft(depth) == expected
+
+
+# -- antichess -------------------------------------------------------------
+
+
+def test_antichess_forced_capture():
+    b = Board(STARTPOS.replace(" KQkq", " -"), Variant.ANTICHESS)
+    b.push_uci("e2e3")
+    b.push_uci("b7b5")
+    # Bxb5 is the only capture, so it is the only legal move.
+    assert b.legal_moves() == ["f1b5"]
+
+
+def test_antichess_king_promotion_and_win():
+    b = Board("8/P7/8/8/8/8/8/k7 w - - 0 1", Variant.ANTICHESS)
+    assert "a7a8k" in b.legal_moves()
+    # Losing all pieces wins: position where side to move has none.
+    b2 = Board("8/8/8/8/8/8/8/k7 w - - 0 1", Variant.ANTICHESS)
+    assert b2.outcome() == Board.VARIANT_WIN  # stm has no pieces -> wins? no:
+    # white to move with NO pieces: no moves -> win for white in antichess.
+
+
+# -- atomic ----------------------------------------------------------------
+
+
+def test_atomic_explosion_removes_adjacent_non_pawns():
+    # exd5 explodes: capturing pawn, captured knight, and the adjacent
+    # knight on e5 all vanish; pawns elsewhere survive.
+    b = Board("3k4/8/8/3nn3/4P3/8/8/3QK3 w - - 0 1", Variant.ATOMIC)
+    b.push_uci("e4d5")
+    fen = b.fen()
+    assert fen.split()[0] == "3k4/8/8/8/8/8/8/3QK3"
+
+
+def test_atomic_kings_cannot_capture():
+    b = Board("3k4/8/8/8/8/8/4r3/4K3 w - - 0 1", Variant.ATOMIC)
+    assert "e1e2" not in b.legal_moves()
+
+
+def test_atomic_adjacent_kings_annul_check():
+    # White king b2 "attacked" by the h2 rook, but kings touch: any quiet
+    # move that keeps the contact is legal.
+    b = Board("8/8/8/8/P7/2k5/1K5r/8 w - - 0 1", Variant.ATOMIC)
+    assert "a4a5" in b.legal_moves()
+
+
+def test_atomic_exploding_enemy_king_wins():
+    b = Board("3k4/3q4/8/8/8/8/8/3QK3 w - - 0 1", Variant.ATOMIC)
+    assert "d1d7" in b.legal_moves()
+    b.push_uci("d1d7")
+    assert b.outcome() == Board.VARIANT_LOSS  # black: king exploded
+
+
+# -- horde -----------------------------------------------------------------
+
+
+def test_horde_startpos_moves():
+    assert len(Board(HORDE_START, Variant.HORDE).legal_moves()) == 8
+
+
+def test_horde_first_rank_double_push():
+    b = Board("rnbqkbnr/pppppppp/8/8/8/8/8/PPPPPPPP w kq - 0 1", Variant.HORDE)
+    moves = b.legal_moves()
+    assert "e1e3" in moves and "e1e2" in moves
+    # ...but a first-rank double push grants no en-passant rights.
+    b.push_uci("e1e3")
+    assert b.fen().split()[3] == "-"
+
+
+def test_horde_white_annihilated_loses():
+    b = Board("4k3/8/8/8/8/8/8/8 w - - 0 1", Variant.HORDE)
+    assert b.outcome() == Board.VARIANT_LOSS
+
+
+# -- racing kings ----------------------------------------------------------
+
+
+def test_racing_kings_no_checks_allowed():
+    b = Board(RK_START, Variant.RACING_KINGS)
+    for mv in b.legal_moves():
+        nxt = b.copy()
+        nxt.push_uci(mv)
+        assert not nxt.is_check(), mv
+
+
+def test_racing_kings_black_equalizing_move():
+    # White king reached rank 8; black king one step away: game goes on.
+    b = Board("7K/5k2/8/8/8/8/8/8 b - - 0 1", Variant.RACING_KINGS)
+    assert b.outcome() == Board.ONGOING
+    draw = b.copy()
+    draw.push_uci("f7f8")
+    assert draw.outcome() == Board.DRAW
+    lose = b.copy()
+    lose.push_uci("f7e6")
+    assert lose.outcome() == Board.VARIANT_WIN  # white (to move) has won
+
+
+def test_racing_kings_black_cannot_equalize():
+    b = Board("7K/8/4k3/8/8/8/8/8 b - - 0 1", Variant.RACING_KINGS)
+    assert b.outcome() == Board.VARIANT_LOSS
+
+
+# -- crazyhouse ------------------------------------------------------------
+
+
+def test_crazyhouse_pocket_and_drops():
+    b = Board(STARTPOS.replace("NR w", "NR[] w"), Variant.CRAZYHOUSE)
+    for mv in ["e2e4", "d7d5", "e4d5", "d8d5"]:
+        b.push_uci(mv)
+    # Both sides pocketed a pawn.
+    assert "[Pp]" in b.fen()
+    assert "P@e4" in b.legal_moves()
+
+
+def test_crazyhouse_en_passant_fills_pocket():
+    b = Board(STARTPOS.replace("NR w", "NR[] w"), Variant.CRAZYHOUSE)
+    for mv in ["e2e4", "g8f6", "e4e5", "d7d5", "e5d6"]:  # exd6 e.p.
+        b.push_uci(mv)
+    assert "[P]" in b.fen()
+
+
+def test_crazyhouse_promoted_piece_demotes_to_pawn():
+    b = Board("k7/7P/8/8/8/8/7r/K7[] w - - 0 1", Variant.CRAZYHOUSE)
+    b.push_uci("h7h8q")
+    assert "Q~" in b.fen()
+    b.push_uci("h2h8")
+    fen = b.fen()
+    assert "[p]" in fen and "~" not in fen
+
+
+def test_crazyhouse_fen_roundtrip_promoted():
+    fen = "k6Q~/8/8/8/8/8/8/K7[Rp] b - - 0 1"
+    assert Board(fen, Variant.CRAZYHOUSE).fen() == fen
+
+
+def test_crazyhouse_drop_blocks_mate():
+    # Back-rank check; the only defenses include dropping a piece between
+    # king and rook.
+    b = Board("6k1/5ppp/8/8/8/8/8/4R1K1[n] b - - 0 1", Variant.CRAZYHOUSE)
+    b.push_uci("g8h8")  # quiet
+    b2 = Board("7k/5ppp/8/8/8/8/8/4R1K1[n] w - - 0 1", Variant.CRAZYHOUSE)
+    b2.push_uci("e1e8")
+    assert "N@f8" in b2.legal_moves() or "N@g8" in b2.legal_moves()
+
+
+# -- three-check -----------------------------------------------------------
+
+
+def test_three_check_fen_roundtrip():
+    fen = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 3+3 0 1"
+    assert Board(fen, Variant.THREE_CHECK).fen() == fen
+
+
+def test_three_check_accepts_legacy_trailing_format():
+    fen = STARTPOS + " +1+0"
+    b = Board(fen, Variant.THREE_CHECK)
+    assert "2+3" in b.fen()  # white has delivered one check
+
+
+def test_three_check_third_check_wins():
+    b = Board("4k3/8/8/8/8/8/8/4KQ2 w - - 1+3 0 1", Variant.THREE_CHECK)
+    b.push_uci("f1b5")  # third check by white
+    assert b.outcome() == Board.VARIANT_LOSS  # black to move, lost
+
+
+# -- king of the hill ------------------------------------------------------
+
+
+def test_koth_center_wins():
+    b = Board("4k3/8/8/8/8/4K3/8/8 w - - 0 1", Variant.KING_OF_THE_HILL)
+    b.push_uci("e3e4")
+    assert b.outcome() == Board.VARIANT_LOSS  # black: enemy king on the hill
+
+
+# -- batched variant searches through the service --------------------------
+
+pytestmark_async = pytest.mark.anyio
+
+
+@pytest.fixture(scope="module")
+def service():
+    svc = SearchService(
+        weights=NnueWeights.random(seed=5),
+        pool_slots=16,
+        batch_capacity=64,
+        tt_bytes=8 << 20,
+        backend="scalar",
+    )
+    yield svc
+    svc.close()
+
+
+@pytest.mark.anyio
+async def test_service_atomic_winning_capture(service):
+    res = await service.search(
+        "3k4/3q4/8/8/8/8/8/3QK3 w - - 0 1", [], depth=4, variant=Variant.ATOMIC
+    )
+    assert res.best_move == "d1d7"
+    final = [l for l in res.lines if l.multipv == 1][-1]
+    assert final.is_mate and final.value == 1
+
+
+@pytest.mark.anyio
+async def test_service_antichess_forced_capture(service):
+    res = await service.search(
+        "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w - - 0 1",
+        ["e2e3", "b7b5"],
+        depth=4,
+        variant=Variant.ANTICHESS,
+    )
+    assert res.best_move == "f1b5"
+
+
+@pytest.mark.anyio
+async def test_service_three_check_finds_checking_move(service):
+    res = await service.search(
+        "4k3/8/8/8/8/8/8/4KQ2 w - - 1+3 0 1", [], depth=4,
+        variant=Variant.THREE_CHECK,
+    )
+    final = [l for l in res.lines if l.multipv == 1][-1]
+    assert final.is_mate and final.value == 1  # third check = mate score
+
+
+@pytest.mark.anyio
+async def test_service_koth_walks_to_center(service):
+    res = await service.search(
+        "4k3/8/8/8/8/4K3/8/8 w - - 0 1", [], depth=4,
+        variant=Variant.KING_OF_THE_HILL,
+    )
+    assert res.best_move in {"e3e4", "e3d4"}
+    final = [l for l in res.lines if l.multipv == 1][-1]
+    assert final.is_mate and final.value == 1
+
+
+@pytest.mark.anyio
+async def test_service_variant_and_standard_concurrently(service):
+    import asyncio
+
+    standard = service.search("6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1", [], depth=4)
+    variant = service.search(
+        "4k3/8/8/8/8/4K3/8/8 w - - 0 1", [], depth=4,
+        variant=Variant.KING_OF_THE_HILL,
+    )
+    res_std, res_koth = await asyncio.gather(standard, variant)
+    assert res_std.best_move == "d1d8"
+    assert res_koth.best_move in {"e3e4", "e3d4"}
