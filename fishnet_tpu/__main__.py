@@ -72,6 +72,23 @@ def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
                 weights=NnueWeights.random(seed=0), batch_capacity=opt.resolved_microbatch()
             )
         return TpuNnueEngineFactory(service)
+    if engine == "az-mcts":
+        import jax
+
+        from fishnet_tpu.engine.az_engine import AzMctsEngineFactory, AzMctsService
+        from fishnet_tpu.models.az import init_az_params
+        from fishnet_tpu.search.mcts import MctsConfig
+
+        cfg = MctsConfig(batch_capacity=opt.resolved_microbatch())
+        if opt.az_net_file:
+            import numpy as np
+
+            loaded = np.load(opt.az_net_file)
+            params = {k: loaded[k] for k in loaded.files}
+        else:
+            logger.warn("No --az-net-file given; using random policy+value net (dev mode).")
+            params = init_az_params(jax.random.PRNGKey(0), cfg.az)
+        return AzMctsEngineFactory(AzMctsService(params, cfg))
     if engine == "uci":
         from fishnet_tpu.engine.uci import UciEngineFactory
 

@@ -141,7 +141,7 @@ def parse_toggle(s: str) -> Optional[bool]:
 
 COMMANDS = ("run", "configure", "systemd", "systemd-user", "license")
 
-ENGINE_BACKENDS = ("tpu-nnue", "uci", "mock")
+ENGINE_BACKENDS = ("tpu-nnue", "az-mcts", "uci", "mock")
 
 
 @dataclass
@@ -170,6 +170,7 @@ class Opt:
     engine: Optional[str] = None
     engine_exe: Optional[str] = None
     nnue_file: Optional[str] = None
+    az_net_file: Optional[str] = None
     microbatch: Optional[int] = None
 
     def conf_path(self) -> Path:
@@ -228,6 +229,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--engine-exe", help="UCI engine executable for --engine uci.")
     p.add_argument("--nnue-file", help="Path to HalfKAv2_hm .nnue weights for the TPU evaluator.")
     p.add_argument("--microbatch", type=int, default=None, help="TPU eval microbatch size (default 1024).")
+    p.add_argument("--az-net-file", default=None,
+                   help="Policy+value net checkpoint (.npz) for --engine az-mcts.")
     return p
 
 
@@ -235,7 +238,8 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
     opt = Opt(command=ns.command, verbose=ns.verbose, auto_update=ns.auto_update,
               conf=ns.conf, no_conf=ns.no_conf, key_file=ns.key_file,
               no_stats_file=ns.no_stats_file, stats_file=ns.stats_file,
-              engine_exe=ns.engine_exe, nnue_file=ns.nnue_file)
+              engine_exe=ns.engine_exe, nnue_file=ns.nnue_file,
+              az_net_file=ns.az_net_file)
     if ns.conf and ns.no_conf:
         raise ConfigError("--conf conflicts with --no-conf")
     if ns.key and ns.key_file:
@@ -278,6 +282,7 @@ _INI_FIELDS = (
     ("Engine", "engine", lambda s: s if s in ENGINE_BACKENDS else _bad_engine(s)),
     ("EngineExe", "engine_exe", str),
     ("NnueFile", "nnue_file", str),
+    ("AzNetFile", "az_net_file", str),
 )
 
 

@@ -1,0 +1,223 @@
+"""The az-mcts engine: batched-PUCT MCTS behind the engine seam.
+
+Fourth backend at the reference's engine-process boundary
+(src/stockfish.rs / src/ipc.rs): like tpu-nnue it serves every worker
+from one shared batched evaluator, but the search is PUCT over the
+AlphaZero-style policy+value net (BASELINE.json config 5) instead of
+alpha-beta over NNUE. Standard chess only — variant work raises, so the
+scheduler's flavor routing must keep variants on another backend.
+
+Topology mirrors SearchService: a single driver thread steps the
+MctsPool (collect leaves from every live search -> one fixed-shape JAX
+microbatch -> expand/backup), while asyncio workers await futures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from fishnet_tpu.engine.base import Engine, EngineError, EngineFactory
+from fishnet_tpu.ipc import Position, PositionResponse
+from fishnet_tpu.protocol.types import EngineFlavor, Matrix, Score, Variant
+from fishnet_tpu.search.mcts import MctsConfig, MctsPool, MctsResult
+
+# Analysis node budgets are calibrated for alpha-beta nodes; a PUCT visit
+# costs ~3 orders of magnitude more compute, so scale the protocol's node
+# budget down to a visit budget (reference servers send ~1.5M nodes;
+# /1024 gives ~1.5k visits, a sound default analysis depth for a net).
+NODES_PER_VISIT = 1024
+
+
+@dataclass
+class _PendingSearch:
+    future: asyncio.Future
+    loop: asyncio.AbstractEventLoop
+    deadline: Optional[float]
+
+
+class AzMctsService:
+    """Owns the MctsPool and its driver thread."""
+
+    def __init__(self, params: Dict, cfg: MctsConfig = MctsConfig()) -> None:
+        self.pool = MctsPool(params, cfg)
+        self._pending: Dict[int, _PendingSearch] = {}
+        self._submissions: List[Tuple[str, List[str], int, Optional[float],
+                                      asyncio.Future, asyncio.AbstractEventLoop]] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stopping = False
+        self._thread = threading.Thread(target=self._drive, daemon=True,
+                                        name="az-mcts-driver")
+        self._thread.start()
+
+    async def search(self, root_fen: str, moves: List[str], visits: int,
+                     movetime_seconds: Optional[float] = None) -> MctsResult:
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        with self._lock:
+            if self._stopping:
+                raise EngineError("az-mcts service is shut down")
+            self._submissions.append(
+                (root_fen, moves, visits, movetime_seconds, future, loop)
+            )
+        self._wake.set()
+        return await future
+
+    def close(self) -> None:
+        with self._lock:
+            self._stopping = True
+        self._wake.set()
+        self._thread.join(timeout=60)
+
+    # -- driver thread ----------------------------------------------------
+
+    def _drive(self) -> None:
+        try:
+            self.pool.warmup()
+            self._drive_inner()
+        except Exception as err:  # noqa: BLE001 - driver must not die silently
+            with self._lock:
+                self._stopping = True
+                pending = list(self._pending.values())
+                self._pending.clear()
+                subs = self._submissions
+                self._submissions = []
+            for p in pending:
+                p.loop.call_soon_threadsafe(
+                    _set_exception_if_waiting, p.future,
+                    EngineError(f"az-mcts driver crashed: {err!r}"))
+            for sub in subs:
+                sub[4].get_loop().call_soon_threadsafe(
+                    _set_exception_if_waiting, sub[4],
+                    EngineError(f"az-mcts driver crashed: {err!r}"))
+            raise
+
+    def _drive_inner(self) -> None:
+        while True:
+            if self._stopping:
+                with self._lock:
+                    pending = list(self._pending.values())
+                    self._pending.clear()
+                    subs, self._submissions = self._submissions, []
+                err = EngineError("az-mcts service shut down")
+                for p in pending:
+                    p.loop.call_soon_threadsafe(
+                        _set_exception_if_waiting, p.future, err)
+                for sub in subs:  # queued but never submitted: fail, don't hang
+                    sub[5].call_soon_threadsafe(
+                        _set_exception_if_waiting, sub[4], err)
+                return
+
+            with self._lock:
+                submissions, self._submissions = self._submissions, []
+            for fen, moves, visits, movetime, future, loop in submissions:
+                try:
+                    sid = self.pool.submit(fen, moves, visits)
+                except Exception as err:  # noqa: BLE001 - bad position
+                    loop.call_soon_threadsafe(
+                        _set_exception_if_waiting, future,
+                        EngineError(f"submit failed: {err!r}"))
+                    continue
+                deadline = time.monotonic() + movetime if movetime else None
+                self._pending[sid] = _PendingSearch(future, loop, deadline)
+
+            now = time.monotonic()
+            for sid, p in self._pending.items():
+                if p.deadline is not None and now >= p.deadline:
+                    self.pool.stop_search(sid)
+
+            evaluated = self.pool.step()
+
+            for sid in self.pool.finished():
+                p = self._pending.pop(sid, None)
+                result = self.pool.harvest(sid)
+                if p is not None:
+                    p.loop.call_soon_threadsafe(_set_result_if_waiting,
+                                                p.future, result)
+
+            if evaluated == 0 and self.pool.active() == 0:
+                got = self._wake.wait(timeout=0.05)
+                if got:
+                    self._wake.clear()
+
+
+def _set_result_if_waiting(future: asyncio.Future, result) -> None:
+    if not future.done():
+        future.set_result(result)
+
+
+def _set_exception_if_waiting(future: asyncio.Future, err: BaseException) -> None:
+    if not future.done():
+        future.set_exception(err)
+
+
+class AzMctsEngine(Engine):
+    def __init__(self, service: AzMctsService, flavor: EngineFlavor) -> None:
+        self.service = service
+        self.flavor = flavor
+
+    async def close(self) -> None:
+        # The service is shared and outlives individual engine handles.
+        return None
+
+    async def go(self, position: Position) -> PositionResponse:
+        if position.variant is not Variant.STANDARD:
+            raise EngineError("az-mcts serves standard chess only")
+        work = position.work
+        if work.is_analysis:
+            nodes = work.nodes.get(position.flavor.eval_flavor())
+            visits = max(64, nodes // NODES_PER_VISIT)
+            movetime = None
+        else:
+            level = work.level
+            visits = 1 << 20  # bounded by movetime, not visits
+            movetime = level.movetime_ms() / 1000.0
+
+        try:
+            result = await self.service.search(
+                position.root_fen, position.moves, visits, movetime
+            )
+        except EngineError:
+            raise
+        except Exception as err:  # noqa: BLE001
+            raise EngineError(f"az-mcts search failed: {err!r}") from err
+
+        if result.best_move is None:
+            # Terminal root: report mate/stalemate like the UCI driver does.
+            board_outcome_mate = result.value <= -0.999
+            scores = Matrix()
+            pvs = Matrix()
+            scores.set(1, 0, Score.mate(0) if board_outcome_mate else Score.cp(0))
+            pvs.set(1, 0, [])
+            return PositionResponse(
+                work=work, position_id=position.position_id,
+                scores=scores, pvs=pvs, best_move=None, depth=0,
+                nodes=0, time_seconds=result.time_seconds, nps=None,
+                url=position.url,
+            )
+
+        scores = Matrix()
+        pvs = Matrix()
+        depth = max(1, result.depth)
+        scores.set(1, depth, Score.cp(result.cp))
+        pvs.set(1, depth, result.pv)
+        nodes = result.visits * NODES_PER_VISIT  # protocol-comparable scale
+        nps = int(nodes / result.time_seconds) if result.time_seconds > 0 else None
+        return PositionResponse(
+            work=work, position_id=position.position_id,
+            scores=scores, pvs=pvs, best_move=result.best_move,
+            depth=depth, nodes=nodes, time_seconds=result.time_seconds,
+            nps=nps, url=position.url,
+        )
+
+
+class AzMctsEngineFactory(EngineFactory):
+    def __init__(self, service: AzMctsService) -> None:
+        self.service = service
+
+    async def create(self, flavor: EngineFlavor) -> Engine:
+        return AzMctsEngine(self.service, flavor)

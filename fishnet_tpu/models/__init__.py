@@ -1,0 +1,29 @@
+"""Model families served by the framework.
+
+* ``az`` — AlphaZero-style policy+value residual network with the
+  73-plane move encoding, the evaluator behind the batched-PUCT MCTS
+  engine (BASELINE.json config 5).
+
+The NNUE family (HalfKAv2_hm) lives in :mod:`fishnet_tpu.nnue` (serving)
+and :mod:`fishnet_tpu.train` (training) for historical layering reasons.
+"""
+
+from fishnet_tpu.models.az import AzConfig, az_forward, init_az_params
+from fishnet_tpu.models.az_encoding import (
+    INPUT_PLANES,
+    POLICY_SIZE,
+    board_planes,
+    legal_policy_indices,
+    move_to_index,
+)
+
+__all__ = [
+    "AzConfig",
+    "az_forward",
+    "init_az_params",
+    "INPUT_PLANES",
+    "POLICY_SIZE",
+    "board_planes",
+    "legal_policy_indices",
+    "move_to_index",
+]

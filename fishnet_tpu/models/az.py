@@ -1,0 +1,117 @@
+"""AlphaZero-style policy+value residual network, TPU-shaped.
+
+A conv tower over 8x8x19 input planes with two heads: a 73-plane policy
+(4672 logits, az_encoding.py) and a tanh value in [-1, 1] from the side
+to move's perspective. The reference has no neural policy/value path at
+all (its engines are alpha-beta C++); this family exists for the
+batched-PUCT MCTS engine of BASELINE.json config 5.
+
+TPU shaping choices:
+
+* compute in bfloat16 (MXU-native), parameters in float32;
+* NHWC layout with channel counts that are multiples of 8 so XLA tiles
+  convs onto the MXU without padding waste;
+* no batch norm at inference — the net uses pre-activation residual
+  blocks with simple bias (training-time normalization is folded in), so
+  the whole forward is a fusion-friendly chain of conv+add+relu;
+* everything under one ``jax.jit`` with static shapes: the MCTS engine
+  always evaluates fixed-capacity microbatches, padding short batches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fishnet_tpu.models.az_encoding import INPUT_PLANES, POLICY_SIZE
+
+Params = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class AzConfig:
+    channels: int = 64
+    blocks: int = 6
+    value_hidden: int = 128
+    policy_planes: int = 73
+
+    @property
+    def policy_size(self) -> int:
+        return 64 * self.policy_planes
+
+
+def init_az_params(rng: jax.Array, cfg: AzConfig = AzConfig()) -> Params:
+    c = cfg.channels
+    keys = jax.random.split(rng, 4 + 2 * cfg.blocks)
+
+    def conv(key, cin, cout, k=3):
+        scale = np.sqrt(2.0 / (k * k * cin))
+        return jax.random.normal(key, (k, k, cin, cout), jnp.float32) * scale
+
+    params: Params = {
+        "stem_w": conv(keys[0], INPUT_PLANES, c),
+        "stem_b": jnp.zeros((c,), jnp.float32),
+        "policy_w": conv(keys[1], c, cfg.policy_planes, k=1),
+        "policy_b": jnp.zeros((cfg.policy_planes,), jnp.float32),
+        "value_w": conv(keys[2], c, 4, k=1),
+        "value_b": jnp.zeros((4,), jnp.float32),
+        "value_fc1_w": jax.random.normal(keys[3], (4 * 64, cfg.value_hidden), jnp.float32)
+        * np.sqrt(2.0 / (4 * 64)),
+        "value_fc1_b": jnp.zeros((cfg.value_hidden,), jnp.float32),
+        "value_fc2_w": jnp.zeros((cfg.value_hidden, 1), jnp.float32),
+        "value_fc2_b": jnp.zeros((1,), jnp.float32),
+    }
+    for i in range(cfg.blocks):
+        params[f"res{i}_w1"] = conv(keys[4 + 2 * i], c, c)
+        params[f"res{i}_b1"] = jnp.zeros((c,), jnp.float32)
+        params[f"res{i}_w2"] = conv(keys[5 + 2 * i], c, c)
+        params[f"res{i}_b2"] = jnp.zeros((c,), jnp.float32)
+    return params
+
+
+def _conv2d(x: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
+    out = jax.lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(1, 1),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out + b.astype(x.dtype)
+
+
+def az_forward(params: Params, planes: jax.Array, cfg: AzConfig = AzConfig()):
+    """planes [B, 8, 8, 19] -> (policy_logits [B, 4672], value [B]).
+
+    Compute runs in bfloat16; logits/value are returned in float32.
+    """
+    x = planes.astype(jnp.bfloat16)
+    x = jax.nn.relu(_conv2d(x, params["stem_w"], params["stem_b"]))
+    for i in range(cfg.blocks):
+        h = jax.nn.relu(_conv2d(x, params[f"res{i}_w1"], params[f"res{i}_b1"]))
+        h = _conv2d(h, params[f"res{i}_w2"], params[f"res{i}_b2"])
+        x = jax.nn.relu(x + h)
+
+    pol = _conv2d(x, params["policy_w"], params["policy_b"])
+    policy_logits = pol.reshape(pol.shape[0], -1).astype(jnp.float32)
+    # NHWC reshape order = square-major within plane-minor; reorder to the
+    # square*73+plane indexing of az_encoding.move_to_index.
+    # pol[b, r, f, p] -> index (r*8+f)*73 + p: reshape already yields
+    # b, (r*8+f)*planes + p, which is exactly that. (No permute needed.)
+
+    v = jax.nn.relu(_conv2d(x, params["value_w"], params["value_b"]))
+    v = v.reshape(v.shape[0], -1)
+    v = jax.nn.relu(v @ params["value_fc1_w"].astype(v.dtype) + params["value_fc1_b"].astype(v.dtype))
+    v = jnp.tanh(v @ params["value_fc2_w"].astype(v.dtype) + params["value_fc2_b"].astype(v.dtype))
+    return policy_logits, v[:, 0].astype(jnp.float32)
+
+
+def value_to_centipawns(v: float) -> int:
+    """Map a [-1, 1] value-head output to centipawns for the fishnet
+    protocol (the same tan mapping family Lc0 uses for UCI output)."""
+    v = float(np.clip(v, -0.9999, 0.9999))
+    return int(round(111.7 * np.tan(1.5620688421 * v)))

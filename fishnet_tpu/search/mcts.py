@@ -1,0 +1,358 @@
+"""Batched PUCT MCTS over the AlphaZero-style policy+value net.
+
+This is the framework's second search family (BASELINE.json config 5):
+instead of alpha-beta fibers suspending for NNUE microbatches
+(search/service.py), many PUCT tree searches run concurrently in Python
+and pool their pending leaf evaluations into one fixed-shape JAX
+microbatch per step. Virtual loss lets each tree contribute several
+leaves per step (leaf parallelism), which is what keeps the device batch
+full — the same inversion the fiber pool performs for alpha-beta, built
+Lc0-style for MCTS.
+
+The reference has no MCTS at all; its engine tier is alpha-beta C++
+(SURVEY.md §2 components 8-9). Trees here are numpy-array nodes (child
+priors/visits/values in flat arrays), boards are native Board handles,
+and the evaluator is az_forward under one jit with a fixed batch shape.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fishnet_tpu.chess.board import Board
+from fishnet_tpu.models.az import AzConfig, az_forward, value_to_centipawns
+from fishnet_tpu.models.az_encoding import board_planes, legal_policy_indices
+
+__all__ = ["MctsConfig", "MctsPool", "MctsResult"]
+
+
+@dataclass(frozen=True)
+class MctsConfig:
+    cpuct: float = 1.5
+    # Leaves each search may have in flight per step (virtual-loss width).
+    leaves_per_step: int = 8
+    # Device microbatch (fixed jit shape; short batches are padded).
+    batch_capacity: int = 256
+    az: AzConfig = field(default_factory=AzConfig)
+
+
+@dataclass
+class MctsResult:
+    best_move: Optional[str]
+    pv: List[str]
+    value: float  # root value in [-1, 1], side to move's perspective
+    cp: int
+    visits: int
+    depth: int  # principal-variation length
+    time_seconds: float
+
+
+PENDING_CHILD = -2  # edge has an evaluation in flight
+
+
+class _Node:
+    __slots__ = ("moves", "priors", "child", "n", "w", "vloss", "terminal")
+
+    def __init__(self, moves: List[str], priors: np.ndarray,
+                 terminal: Optional[float]) -> None:
+        self.moves = moves
+        self.priors = priors
+        k = len(moves)
+        self.child = np.full(k, -1, dtype=np.int32)  # -1 = unexpanded
+        self.n = np.zeros(k, dtype=np.int64)
+        self.w = np.zeros(k, dtype=np.float64)
+        self.vloss = np.zeros(k, dtype=np.int32)
+        self.terminal = terminal  # value from this node's stm, if game over
+
+
+def _terminal_value(outcome: int) -> Optional[float]:
+    if outcome == Board.ONGOING:
+        return None
+    if outcome in (Board.CHECKMATE, Board.VARIANT_LOSS):
+        return -1.0
+    if outcome == Board.VARIANT_WIN:
+        return 1.0
+    return 0.0  # stalemate / draw
+
+
+class _Search:
+    """One PUCT tree. Nodes live in a list; edges hold child ids."""
+
+    def __init__(self, board: Board, visits: int, cfg: MctsConfig) -> None:
+        self.root_board = board
+        self.cfg = cfg
+        self.budget = max(1, visits)
+        self.nodes: List[_Node] = []
+        self.started = time.monotonic()
+        self.visits_done = 0
+        self.stop = False
+        # Pending leaf evals: (path of (node_id, edge), planes, moves, stm_white)
+        self.pending: List[Tuple[List[Tuple[int, int]], np.ndarray, List[str], bool, str]] = []
+        # The root itself needs an eval before any simulation can run.
+        self._root_ready = False
+
+    # -- tree walking -----------------------------------------------------
+
+    def _select_path(self) -> Optional[Tuple[List[Tuple[int, int]], Board]]:
+        """Walk PUCT from the root to a leaf, applying virtual loss.
+        Returns None on a collision (the walk reached an edge whose
+        evaluation is already in flight) or when it resolved a terminal
+        node in place; collisions release their virtual loss."""
+        cfg = self.cfg
+        path: List[Tuple[int, int]] = []
+        board = self.root_board.copy()
+        node_id = 0
+        while True:
+            node = self.nodes[node_id]
+            if node.terminal is not None:
+                self._backup(path, node.terminal)
+                self.visits_done += 1
+                return None
+            total = int(node.n.sum() + node.vloss.sum())
+            q = np.where(
+                node.n + node.vloss > 0,
+                (node.w - node.vloss) / np.maximum(node.n + node.vloss, 1),
+                0.0,
+            )
+            u = cfg.cpuct * node.priors * (math.sqrt(total + 1) / (1.0 + node.n + node.vloss))
+            edge = int(np.argmax(q + u))
+            child = node.child[edge]
+            if child == PENDING_CHILD:
+                # Collision: virtual loss couldn't steer away (e.g. a
+                # forced move). Undo this walk and let the step's batch go
+                # out; the pending eval will open the subtree.
+                for nid, e in path:
+                    self.nodes[nid].vloss[e] -= 1
+                return None
+            path.append((node_id, edge))
+            node.vloss[edge] += 1
+            board.push_uci(node.moves[edge])
+            if child < 0:
+                return path, board
+            node_id = int(child)
+
+    def _backup(self, path: List[Tuple[int, int]], leaf_value: float) -> None:
+        """Propagate a leaf value (leaf stm perspective) up the path,
+        releasing the virtual loss the selection walk applied."""
+        v = leaf_value
+        for node_id, edge in reversed(path):
+            v = -v  # child stm -> this node's stm
+            node = self.nodes[node_id]
+            node.n[edge] += 1
+            node.w[edge] += v
+            node.vloss[edge] -= 1
+
+    # -- step api ----------------------------------------------------------
+
+    def collect(self, room: int) -> None:
+        """Run selections until min(cfg.leaves_per_step, room) leaves are
+        pending (or the visit budget / tree is exhausted)."""
+        if not self._root_ready:
+            b = self.root_board
+            moves = b.legal_moves()
+            outcome = b.outcome()
+            if outcome != Board.ONGOING or not moves:
+                # Terminal root: no network needed, search is over.
+                value = _terminal_value(outcome)
+                self.nodes.append(
+                    _Node([], np.zeros(0, np.float32),
+                          value if value is not None else 0.0)
+                )
+                self._root_ready = True
+                return
+            if room > 0:
+                self.pending.append(
+                    ([], board_planes(b.fen()), moves, b.turn() == "w", "root")
+                )
+            return
+        width = min(self.cfg.leaves_per_step, room)
+        attempts = 0
+        max_attempts = self.cfg.leaves_per_step * 4
+        while (
+            len(self.pending) < width
+            and self.visits_done + len(self.pending) < self.budget
+            and not self.stop
+            and attempts < max_attempts
+        ):
+            attempts += 1
+            out = self._select_path()
+            if out is None:
+                continue
+            path, board = out
+            moves = board.legal_moves()
+            outcome = board.outcome()
+            if outcome != Board.ONGOING or not moves:
+                value = _terminal_value(outcome)
+                node = _Node([], np.zeros(0, np.float32),
+                             value if value is not None else 0.0)
+                self.nodes.append(node)
+                parent_id, edge = path[-1]
+                self.nodes[parent_id].child[edge] = len(self.nodes) - 1
+                self._backup(path, node.terminal or 0.0)
+                self.visits_done += 1
+                continue
+            parent_id, edge = path[-1]
+            self.nodes[parent_id].child[edge] = PENDING_CHILD
+            self.pending.append((path, board_planes(board.fen()), moves,
+                                 board.turn() == "w", "leaf"))
+
+    def apply_evals(self, results: List[Tuple[np.ndarray, float]]) -> None:
+        """results[i] = (policy_logits [4672], value) for self.pending[i]."""
+        for (path, _planes, moves, stm_white, kind), (logits, value) in zip(
+            self.pending, results
+        ):
+            idx = legal_policy_indices(moves, stm_white)
+            logit = logits[idx]
+            if logit.size:
+                logit = logit - logit.max()
+                priors = np.exp(logit)
+                priors /= priors.sum()
+            else:
+                priors = logit
+            node = _Node(moves, priors.astype(np.float32), None)
+            self.nodes.append(node)
+            node_id = len(self.nodes) - 1
+            if kind == "root":
+                assert node_id == 0
+                self._root_ready = True
+            else:
+                parent_id, edge = path[-1]
+                self.nodes[parent_id].child[edge] = node_id
+                self._backup(path, float(value))
+                self.visits_done += 1
+        self.pending = []
+
+    @property
+    def done(self) -> bool:
+        if not self._root_ready:
+            return False
+        if self.nodes[0].terminal is not None or not self.nodes[0].moves:
+            return True
+        return self.stop or self.visits_done >= self.budget
+
+    def result(self) -> MctsResult:
+        elapsed = time.monotonic() - self.started
+        if not self.nodes or not self.nodes[0].moves:
+            # Terminal root: surface the terminal value (mate = -1, draw = 0).
+            value = 0.0
+            if self.nodes and self.nodes[0].terminal is not None:
+                value = self.nodes[0].terminal
+            return MctsResult(None, [], value, value_to_centipawns(value),
+                              self.visits_done, 0, elapsed)
+        pv: List[str] = []
+        node_id = 0
+        while node_id >= 0 and node_id < len(self.nodes):
+            node = self.nodes[node_id]
+            if not node.moves or node.n.sum() == 0:
+                break
+            edge = int(np.argmax(node.n))
+            pv.append(node.moves[edge])
+            node_id = int(node.child[edge])
+        root = self.nodes[0]
+        best_edge = int(np.argmax(root.n))
+        n = root.n[best_edge]
+        value = float(root.w[best_edge] / n) if n > 0 else 0.0
+        return MctsResult(
+            best_move=root.moves[best_edge],
+            pv=pv,
+            value=value,
+            cp=value_to_centipawns(value),
+            visits=self.visits_done,
+            depth=len(pv),
+            time_seconds=elapsed,
+        )
+
+
+class MctsPool:
+    """Many concurrent PUCT searches sharing one jitted evaluator.
+
+    Synchronous core: callers submit searches, then drive ``step()`` until
+    ``all_done()``. The async engine wrapper (engine/az_engine.py) runs
+    this on a driver thread, mirroring SearchService's topology.
+    """
+
+    def __init__(self, params: Dict, cfg: MctsConfig = MctsConfig()) -> None:
+        import jax
+
+        self.cfg = cfg
+        self.params = params
+        self._forward = jax.jit(lambda p, x: az_forward(p, x, cfg.az))
+        self._searches: Dict[int, _Search] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def warmup(self) -> None:
+        cap = self.cfg.batch_capacity
+        planes = np.zeros((cap, 8, 8, 19), np.float32)
+        logits, values = self._forward(self.params, planes)
+        np.asarray(values)
+
+    def submit(self, fen: str, moves: List[str], visits: int) -> int:
+        board = Board(fen)
+        for m in moves:
+            board.push_uci(m)
+        search = _Search(board, visits, self.cfg)
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            self._searches[sid] = search
+        return sid
+
+    def stop_search(self, sid: int) -> None:
+        with self._lock:
+            search = self._searches.get(sid)
+        if search is not None:
+            search.stop = True
+
+    def step(self) -> int:
+        """One collect -> evaluate -> expand cycle. Returns the number of
+        leaves evaluated (0 when all searches are done/idle)."""
+        with self._lock:
+            searches = list(self._searches.values())
+        contributors: List[Tuple[_Search, int]] = []  # (search, leaf count)
+        planes_list: List[np.ndarray] = []
+        cap = self.cfg.batch_capacity
+        for s in searches:
+            if s.done:
+                continue
+            s.collect(room=cap - len(planes_list))
+            if s.pending:
+                contributors.append((s, len(s.pending)))
+                planes_list.extend(item[1] for item in s.pending)
+
+        if not planes_list:
+            return 0
+
+        batch = np.zeros((cap, 8, 8, 19), np.float32)
+        batch[: len(planes_list)] = np.stack(planes_list)
+        logits, values = self._forward(self.params, batch)
+        logits = np.asarray(logits)
+        values = np.asarray(values)
+
+        cursor = 0
+        for s, k in contributors:
+            results = [
+                (logits[cursor + j], float(values[cursor + j])) for j in range(k)
+            ]
+            cursor += k
+            s.apply_evals(results)
+        return len(planes_list)
+
+    def finished(self) -> List[int]:
+        with self._lock:
+            return [sid for sid, s in self._searches.items() if s.done]
+
+    def harvest(self, sid: int) -> MctsResult:
+        with self._lock:
+            search = self._searches.pop(sid)
+        return search.result()
+
+    def active(self) -> int:
+        with self._lock:
+            return sum(0 if s.done else 1 for s in self._searches.values())

@@ -1,0 +1,222 @@
+"""AlphaZero model family: move/plane encodings, the policy+value net,
+batched PUCT MCTS, and the az-mcts engine end-to-end against the fake
+lichess server (BASELINE.json config 5)."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from fishnet_tpu.chess.board import Board
+from fishnet_tpu.models.az import AzConfig, az_forward, init_az_params, value_to_centipawns
+from fishnet_tpu.models.az_encoding import (
+    INPUT_PLANES,
+    POLICY_SIZE,
+    board_planes,
+    move_to_index,
+)
+from fishnet_tpu.search.mcts import MctsConfig, MctsPool
+
+STARTPOS = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+TINY = AzConfig(channels=16, blocks=2, value_hidden=16)
+
+
+# -- move encoding ---------------------------------------------------------
+
+
+def test_move_index_known_values():
+    # e2e4: from-square e2 = 12, north 2 steps = plane 1.
+    assert move_to_index("e2e4", True) == 12 * 73 + 1
+    # g1f3 (knight, df=-1, dr=+2): plane 56 + index of (-1,2)... computed:
+    idx = move_to_index("g1f3", True)
+    assert 6 * 73 + 56 <= idx < 6 * 73 + 64
+    # Underpromotion capture: a7xb8=n, df=+1 -> plane 64 + 0*3 + 2.
+    assert move_to_index("a7b8n", True) == 48 * 73 + 64 + 2
+
+
+def test_move_index_black_flip():
+    # Black's e7e5 must encode like white's e2e4 (perspective flip).
+    assert move_to_index("e7e5", False) == move_to_index("e2e4", True)
+
+
+def test_move_index_unique_over_legal_moves():
+    fens = [
+        STARTPOS,
+        "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1",
+        "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R b KQkq - 0 1",
+        "r3k2r/Pppp1ppp/1b3nbN/nP6/BBP1P3/q4N2/Pp1P2PP/R2Q1RK1 w kq - 0 1",
+    ]
+    for fen in fens:
+        board = Board(fen)
+        white = board.turn() == "w"
+        indices = [move_to_index(m, white) for m in board.legal_moves()]
+        assert len(set(indices)) == len(indices), fen
+        assert all(0 <= i < POLICY_SIZE for i in indices)
+
+
+def test_drop_moves_rejected():
+    with pytest.raises(ValueError):
+        move_to_index("P@e4", True)
+
+
+# -- planes ----------------------------------------------------------------
+
+
+def test_startpos_planes():
+    planes = board_planes(STARTPOS)
+    assert planes.shape == (8, 8, INPUT_PLANES)
+    assert planes[:, :, 0].sum() == 8  # own pawns
+    assert planes[:, :, 6].sum() == 8  # opponent pawns
+    assert planes[1, :, 0].sum() == 8  # own pawns on rank 2
+    assert planes[:, :, 12].all() and planes[:, :, 15].all()  # castling
+    assert planes[:, :, 18].all()
+
+
+def test_black_perspective_flip():
+    # After 1.e4, black sees white's e-pawn as an *opponent* pawn on its
+    # own 4th rank (from black's perspective).
+    after_e4 = "rnbqkbnr/pppppppp/8/8/4P3/8/PPPP1PPP/RNBQKBNR b KQkq - 0 1"
+    planes = board_planes(after_e4)
+    assert planes[:, :, 0].sum() == 8  # black's pawns, still on "rank 2"
+    assert planes[1, :, 0].sum() == 8
+    assert planes[4, 4, 6] == 1.0  # white e4 pawn -> opp plane, flipped rank
+
+
+# -- network ---------------------------------------------------------------
+
+
+def test_az_forward_shapes_and_finite():
+    params = init_az_params(jax.random.PRNGKey(0), TINY)
+    planes = np.stack([board_planes(STARTPOS)] * 4)
+    logits, values = jax.jit(lambda p, x: az_forward(p, x, TINY))(params, planes)
+    assert logits.shape == (4, POLICY_SIZE)
+    assert values.shape == (4,)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert np.all(np.abs(np.asarray(values)) <= 1.0)
+
+
+def test_value_to_centipawns_monotone():
+    vals = [value_to_centipawns(v) for v in (-0.9, -0.5, 0.0, 0.5, 0.9)]
+    assert vals == sorted(vals)
+    assert value_to_centipawns(0.0) == 0
+
+
+# -- MCTS ------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pool():
+    params = init_az_params(jax.random.PRNGKey(1), TINY)
+    return MctsPool(params, MctsConfig(batch_capacity=64, az=TINY))
+
+
+def run_pool(pool, sids):
+    for _ in range(10_000):
+        pool.step()
+        if pool.active() == 0:
+            break
+    return {sid: pool.harvest(sid) for sid in sids}
+
+
+def test_mcts_finds_mate_in_one(pool):
+    sid = pool.submit("6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1", [], visits=400)
+    result = run_pool(pool, [sid])[sid]
+    assert result.best_move == "d1d8"
+    assert result.value > 0.8
+    assert result.pv[0] == "d1d8"
+
+
+def test_mcts_terminal_root(pool):
+    # Fool's mate: white is already mated.
+    sid = pool.submit(
+        "rnb1kbnr/pppp1ppp/8/4p3/6Pq/5P2/PPPPP2P/RNBQKBNR w KQkq - 1 3",
+        [], visits=64,
+    )
+    result = run_pool(pool, [sid])[sid]
+    assert result.best_move is None
+    assert result.value == -1.0
+
+
+def test_mcts_concurrent_searches(pool):
+    sids = [
+        pool.submit(STARTPOS, ["e2e4"], visits=48),
+        pool.submit(STARTPOS, [], visits=48),
+        pool.submit("6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1", [], visits=200),
+    ]
+    results = run_pool(pool, sids)
+    assert all(r.best_move for r in results.values())
+    assert results[sids[2]].best_move == "d1d8"
+    assert all(r.visits > 0 for r in results.values())
+
+
+def test_mcts_forced_move_no_duplicate_expansion(pool):
+    # Black has exactly one legal move: every selection walk collides on
+    # the same pending edge; the search must still complete the budget
+    # without duplicating expansions.
+    sid = pool.submit("k7/8/2K5/8/8/8/8/1R6 b - - 0 1", [], visits=24)
+    result = run_pool(pool, [sid])[sid]
+    assert result.best_move == "a8a7"
+    assert result.visits >= 24
+
+
+def test_mcts_avoids_stalemate_draw(pool):
+    # KQ vs K: random net, but terminal draws backpropagate 0 while the
+    # mating lines backpropagate +1 — search must not pick the stalemate.
+    sid = pool.submit("7k/5Q2/5K2/8/8/8/8/8 w - - 0 1", [], visits=300)
+    result = run_pool(pool, [sid])[sid]
+    board = Board("7k/5Q2/5K2/8/8/8/8/8 w - - 0 1")
+    board.push_uci(result.best_move)
+    assert board.outcome() != Board.STALEMATE
+
+
+# -- async service + engine e2e -------------------------------------------
+
+pytestmark = pytest.mark.anyio
+
+
+async def test_az_service_search():
+    from fishnet_tpu.engine.az_engine import AzMctsService
+
+    params = init_az_params(jax.random.PRNGKey(2), TINY)
+    service = AzMctsService(params, MctsConfig(batch_capacity=64, az=TINY))
+    try:
+        results = await asyncio.gather(
+            service.search("6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1", [], 300),
+            service.search(STARTPOS, [], 48),
+        )
+        assert results[0].best_move == "d1d8"
+        assert results[1].best_move
+    finally:
+        service.close()
+
+
+async def test_az_engine_client_e2e():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from fake_server import FakeServer
+    from test_client_e2e import make_client, wait_for
+
+    from fishnet_tpu.engine.az_engine import AzMctsEngineFactory, AzMctsService
+
+    params = init_az_params(jax.random.PRNGKey(3), TINY)
+    service = AzMctsService(params, MctsConfig(batch_capacity=64, az=TINY))
+    try:
+        async with FakeServer() as server:
+            work_id = server.lichess.add_analysis_job(
+                moves="e2e4 e7e5", nodes=70_000  # ~68 visits/position
+            )
+            client = make_client(
+                server.endpoint, cores=1,
+                engine_factory=AzMctsEngineFactory(service),
+            )
+            await client.start()
+            assert await wait_for(lambda: work_id in server.lichess.analyses, timeout=60)
+            await client.stop()
+            parts = server.lichess.analyses[work_id]["analysis"]
+            assert len(parts) == 3
+            assert all("pv" in p for p in parts)
+    finally:
+        service.close()
