@@ -68,11 +68,11 @@ def evaluate_batch(params: Params, indices: jax.Array, buckets: jax.Array) -> ja
     int32 [B]. Returns int32 [B] centipawn scores from the side to move's
     point of view."""
     indices = indices.astype(jnp.int32)
-    # Feature transformer: embedding gather + sum (int32 accumulation).
-    rows = jnp.take(params["ft_w"], indices, axis=0)  # [B, 2, 32, L1] int16
-    acc = params["ft_b"].astype(jnp.int32) + jnp.sum(
-        rows.astype(jnp.int32), axis=2
-    )  # [B, 2, L1]
+    # Feature transformer: fused Pallas gather-accumulate on TPU (single
+    # HBM pass per row), XLA take+sum elsewhere. [B, 2, L1] int32.
+    from fishnet_tpu.ops.ft_gather import ft_accumulate
+
+    acc = ft_accumulate(params["ft_w"], params["ft_b"], indices)
     psqt_rows = jnp.take(params["ft_psqt"], indices, axis=0)  # [B, 2, 32, 8]
     psqt = jnp.sum(psqt_rows, axis=2)  # [B, 2, 8] int32
 

@@ -1,0 +1,9 @@
+"""Custom TPU kernels (Pallas) with XLA fallbacks.
+
+* ``ft_gather`` — fused NNUE feature-transformer gather-accumulate,
+  the evaluator's hot op.
+"""
+
+from fishnet_tpu.ops.ft_gather import ft_accumulate
+
+__all__ = ["ft_accumulate"]

@@ -1,0 +1,140 @@
+"""Pallas TPU kernel for the NNUE feature-transformer gather-accumulate.
+
+The feature transformer is the NNUE hot op: for every position and both
+perspectives, sum ~30 sparse rows of a [22529, 1024] int16 table and add
+the bias. XLA's take+sum lowers to a dynamic-gather that materializes a
+[B, 2, 32, 1024] int16 intermediate in HBM (128 MiB at B=1024) and then
+reduces it — every gathered byte crosses HBM twice. This kernel streams
+each row HBM->VMEM exactly once with 32 concurrent row DMAs per
+accumulator and reduces in VMEM, so the traffic is the 64 KiB of rows
+per accumulator and the 4 KiB result, nothing else.
+
+The weight table stays resident in HBM (46 MiB > VMEM); row addresses
+are data-dependent, which is exactly what PrefetchScalarGridSpec's
+scalar-prefetched index argument enables: the indices are available
+before the kernel body, so the DMAs can be issued immediately.
+
+Used by jax_eval.evaluate_batch on TPU backends; the plain XLA path
+remains the fallback (CPU tests, odd shapes) and the parity test runs
+this kernel in interpreter mode against it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["ft_accumulate"]
+
+
+def _xla_ft_accumulate(ft_w: jax.Array, ft_b: jax.Array, indices: jax.Array) -> jax.Array:
+    rows = jnp.take(ft_w, indices, axis=0)  # [B, 2, A, L1] int16
+    return ft_b.astype(jnp.int32) + jnp.sum(rows.astype(jnp.int32), axis=2)
+
+
+def _kernel(idx_ref, ft_ref, bias_ref, out_ref, rows, sems):
+    b = pl.program_id(0)
+    n_active = rows.shape[0] // 2  # both perspectives share the scratch
+
+    # Issue every row copy up front — the DMA engine overlaps them — then
+    # wait and reduce. Each feature row is viewed as one native (8, 128)
+    # int16 tile, so single-row HBM slices stay tile-aligned. Padded
+    # slots point at the sentinel zero row, so no branches are needed.
+    copies = []
+    for p in range(2):
+        for k in range(n_active):
+            dma = pltpu.make_async_copy(
+                ft_ref.at[idx_ref[b, p, k]], rows.at[p * n_active + k],
+                sems.at[p * n_active + k],
+            )
+            dma.start()
+            copies.append(dma)
+    for dma in copies:
+        dma.wait()
+
+    bias = bias_ref[:].astype(jnp.int32)
+    all_rows = rows[:].astype(jnp.int32)  # [2A, 8S, 128]
+    out_ref[0, 0] = bias + jnp.sum(all_rows[:n_active], axis=0)
+    out_ref[0, 1] = bias + jnp.sum(all_rows[n_active:], axis=0)
+
+
+# Positions per pallas_call: the scalar-prefetch index operand lives in
+# SMEM (1 MiB total), so the whole batch's indices cannot ride one call.
+_CHUNK = 256
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_ft_accumulate(
+    ft_w: jax.Array, ft_b: jax.Array, indices: jax.Array, interpret: bool = False
+) -> jax.Array:
+    batch, persp, n_active = indices.shape
+    l1 = ft_w.shape[1]
+    assert persp == 2, "indices must be [B, 2, MAX_ACTIVE]"
+    assert l1 % 1024 == 0, "L1 must fold into whole (8, 128) int16 tiles"
+    sub = l1 // 128  # sublane count of one feature row viewed as a tile
+
+    # View each L1-wide row as an (sub, 128) tile so single-row HBM
+    # slices are tile-aligned (Mosaic requires sublane multiples of 8).
+    ft_tiles = ft_w.reshape(ft_w.shape[0], sub, 128)
+    bias_tile = ft_b.reshape(sub, 128)
+
+    def run_chunk(idx_chunk: jax.Array) -> jax.Array:
+        chunk = idx_chunk.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(chunk,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.ANY),  # ft_w stays in HBM
+                pl.BlockSpec(memory_space=pltpu.VMEM),  # bias
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 2, sub, 128), lambda b, idx_ref: (b, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((2 * n_active, sub, 128), ft_w.dtype),
+                pltpu.SemaphoreType.DMA((2 * n_active,)),
+            ],
+        )
+        return pl.pallas_call(
+            _kernel,
+            out_shape=jax.ShapeDtypeStruct((chunk, 2, sub, 128), jnp.int32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(idx_chunk, ft_tiles, bias_tile)
+
+    idx = indices.astype(jnp.int32)
+    outs = [
+        run_chunk(idx[start : start + _CHUNK])
+        for start in range(0, batch, _CHUNK)
+    ]
+    out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+    return out.reshape(batch, persp, l1)
+
+
+def ft_accumulate(
+    ft_w: jax.Array,
+    ft_b: jax.Array,
+    indices: jax.Array,
+    *,
+    use_pallas: bool | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Feature-transformer accumulators, bias included: int32 [B, 2, L1].
+
+    ``ft_w`` [N+1, L1] int16 with a zero sentinel row at index N;
+    ``ft_b`` [L1] int16; ``indices`` integer [B, 2, MAX_ACTIVE] padded
+    with N. ``use_pallas=None`` auto-selects: the fused kernel on TPU
+    backends when shapes conform (lane-aligned L1), XLA otherwise.
+    """
+    indices = indices.astype(jnp.int32)
+    if use_pallas is None:
+        use_pallas = (
+            jax.default_backend() == "tpu" and ft_w.shape[1] % 1024 == 0
+        )
+    if use_pallas or interpret:
+        return _pallas_ft_accumulate(ft_w, ft_b, indices, interpret=interpret)
+    return _xla_ft_accumulate(ft_w, ft_b, indices)

@@ -1,0 +1,75 @@
+"""Pallas kernel parity tests (interpreter mode on CPU; the same kernel
+is exercised on real TPU hardware by bench/verify runs)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fishnet_tpu.ops.ft_gather import _xla_ft_accumulate, ft_accumulate
+
+
+def _fixture(n_features=512, l1=1024, batch=5, active=32, seed=0):
+    rng = np.random.default_rng(seed)
+    ft_w = jnp.asarray(
+        np.vstack(
+            [rng.integers(-200, 200, (n_features, l1)), np.zeros((1, l1))]
+        ).astype(np.int16)
+    )
+    ft_b = jnp.asarray(rng.integers(-100, 100, (l1,)).astype(np.int16))
+    idx = rng.integers(0, n_features, (batch, 2, active)).astype(np.int32)
+    # Pad a few slots with the sentinel row like real feature extraction.
+    idx[:, :, active - 3 :] = n_features
+    return ft_w, ft_b, jnp.asarray(idx)
+
+
+def test_pallas_ft_gather_matches_xla_interpret():
+    ft_w, ft_b, idx = _fixture()
+    ref = np.asarray(_xla_ft_accumulate(ft_w, ft_b, idx))
+    got = np.asarray(ft_accumulate(ft_w, ft_b, idx, interpret=True))
+    assert np.array_equal(ref, got)
+
+
+def test_pallas_ft_gather_sentinel_rows_are_noops():
+    ft_w, ft_b, _ = _fixture()
+    n = ft_w.shape[0] - 1
+    idx = jnp.full((3, 2, 32), n, dtype=jnp.int32)  # all padding
+    got = np.asarray(ft_accumulate(ft_w, ft_b, idx, interpret=True))
+    expected = np.broadcast_to(np.asarray(ft_b, np.int32), got.shape)
+    assert np.array_equal(got, expected)
+
+
+def test_auto_selection_falls_back_on_cpu():
+    # On the CPU test backend the auto path must use XLA (and agree).
+    ft_w, ft_b, idx = _fixture(batch=2)
+    auto = np.asarray(ft_accumulate(ft_w, ft_b, idx))
+    ref = np.asarray(_xla_ft_accumulate(ft_w, ft_b, idx))
+    assert np.array_equal(auto, ref)
+
+
+def test_evaluate_batch_still_matches_cpp_oracle_path():
+    # evaluate_batch routes through ft_accumulate now; the existing nnue
+    # parity suite (test_nnue.py) covers full-score parity — here just a
+    # smoke check that the plumbing holds shapes.
+    from fishnet_tpu.nnue import spec
+    from fishnet_tpu.nnue.jax_eval import evaluate_batch, params_from_weights
+    from fishnet_tpu.nnue.weights import NnueWeights
+
+    params = params_from_weights(NnueWeights.random(seed=1))
+    rng = np.random.default_rng(2)
+    idx = rng.integers(
+        0, spec.NUM_FEATURES + 1, (4, 2, spec.MAX_ACTIVE_FEATURES)
+    ).astype(np.int32)
+    buckets = rng.integers(0, spec.NUM_PSQT_BUCKETS, (4,)).astype(np.int32)
+    out = np.asarray(evaluate_batch(params, jnp.asarray(idx), jnp.asarray(buckets)))
+    assert out.shape == (4,)
+    assert np.all(np.abs(out) < 10_000_000)
+
+
+@pytest.mark.parametrize("batch", [1, 3, 300])
+def test_pallas_chunking_boundaries(batch):
+    # _CHUNK = 256: cover under, at-boundary-crossing, and tiny batches.
+    ft_w, ft_b, idx = _fixture(batch=batch, l1=1024)
+    ref = np.asarray(_xla_ft_accumulate(ft_w, ft_b, idx))
+    got = np.asarray(ft_accumulate(ft_w, ft_b, idx, interpret=True))
+    assert np.array_equal(ref, got)
