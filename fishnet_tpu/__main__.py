@@ -88,7 +88,20 @@ def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
         else:
             logger.warn("No --az-net-file given; using random policy+value net (dev mode).")
             params = init_az_params(jax.random.PRNGKey(0), cfg.az)
-        return AzMctsEngineFactory(AzMctsService(params, cfg))
+        # Variant work can't ride the AZ policy encoding; route it to the
+        # native HCE alpha-beta tier (scalar backend: no device traffic).
+        from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
+        from fishnet_tpu.nnue.weights import NnueWeights
+        from fishnet_tpu.search.service import SearchService
+
+        fallback_service = SearchService(
+            weights=NnueWeights.random(seed=0), backend="scalar",
+            pool_slots=64, batch_capacity=64,
+        )
+        return AzMctsEngineFactory(
+            AzMctsService(params, cfg),
+            variant_fallback=TpuNnueEngineFactory(fallback_service),
+        )
     if engine == "uci":
         from fishnet_tpu.engine.uci import UciEngineFactory
 

@@ -4,8 +4,10 @@ Fourth backend at the reference's engine-process boundary
 (src/stockfish.rs / src/ipc.rs): like tpu-nnue it serves every worker
 from one shared batched evaluator, but the search is PUCT over the
 AlphaZero-style policy+value net (BASELINE.json config 5) instead of
-alpha-beta over NNUE. Standard chess only — variant work raises, so the
-scheduler's flavor routing must keep variants on another backend.
+alpha-beta over NNUE. The AZ family serves standard chess; when the
+factory is given a variant_fallback, variant positions route to it
+(the native HCE alpha-beta tier) — mirroring the reference, where
+variant work always runs on Fairy-Stockfish (src/queue.rs:530-539).
 
 Topology mirrors SearchService: a single driver thread steps the
 MctsPool (collect leaves from every live search -> one fixed-shape JAX
@@ -37,6 +39,7 @@ class _PendingSearch:
     future: asyncio.Future
     loop: asyncio.AbstractEventLoop
     deadline: Optional[float]
+    token: object = None
 
 
 class AzMctsService:
@@ -45,8 +48,8 @@ class AzMctsService:
     def __init__(self, params: Dict, cfg: MctsConfig = MctsConfig()) -> None:
         self.pool = MctsPool(params, cfg)
         self._pending: Dict[int, _PendingSearch] = {}
-        self._submissions: List[Tuple[str, List[str], int, Optional[float],
-                                      asyncio.Future, asyncio.AbstractEventLoop]] = []
+        self._submissions: List[tuple] = []
+        self._cancelled_tokens: set = set()
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stopping = False
@@ -55,17 +58,29 @@ class AzMctsService:
         self._thread.start()
 
     async def search(self, root_fen: str, moves: List[str], visits: int,
-                     movetime_seconds: Optional[float] = None) -> MctsResult:
+                     movetime_seconds: Optional[float] = None,
+                     multipv: int = 1) -> MctsResult:
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
+        token = object()
         with self._lock:
             if self._stopping:
                 raise EngineError("az-mcts service is shut down")
             self._submissions.append(
-                (root_fen, moves, visits, movetime_seconds, future, loop)
+                (root_fen, moves, visits, movetime_seconds, future, loop,
+                 multipv, token)
             )
         self._wake.set()
-        return await future
+        try:
+            return await future
+        except asyncio.CancelledError:
+            # Caller timed out / was cancelled (worker budget): stop the
+            # underlying search so it frees its batch slots instead of
+            # draining its full visit budget as an orphan.
+            with self._lock:
+                self._cancelled_tokens.add(token)
+            self._wake.set()
+            raise
 
     def close(self) -> None:
         with self._lock:
@@ -114,20 +129,26 @@ class AzMctsService:
 
             with self._lock:
                 submissions, self._submissions = self._submissions, []
-            for fen, moves, visits, movetime, future, loop in submissions:
+                cancelled, self._cancelled_tokens = self._cancelled_tokens, set()
+            for fen, moves, visits, movetime, future, loop, multipv, token in submissions:
+                if token in cancelled:
+                    cancelled.discard(token)
+                    continue
                 try:
-                    sid = self.pool.submit(fen, moves, visits)
+                    sid = self.pool.submit(fen, moves, visits, multipv=multipv)
                 except Exception as err:  # noqa: BLE001 - bad position
                     loop.call_soon_threadsafe(
                         _set_exception_if_waiting, future,
                         EngineError(f"submit failed: {err!r}"))
                     continue
                 deadline = time.monotonic() + movetime if movetime else None
-                self._pending[sid] = _PendingSearch(future, loop, deadline)
+                self._pending[sid] = _PendingSearch(future, loop, deadline, token)
 
             now = time.monotonic()
             for sid, p in self._pending.items():
-                if p.deadline is not None and now >= p.deadline:
+                if p.token in cancelled:
+                    self.pool.stop_search(sid)
+                elif p.deadline is not None and now >= p.deadline:
                     self.pool.stop_search(sid)
 
             evaluated = self.pool.step()
@@ -172,14 +193,17 @@ class AzMctsEngine(Engine):
             nodes = work.nodes.get(position.flavor.eval_flavor())
             visits = max(64, nodes // NODES_PER_VISIT)
             movetime = None
+            multipv = work.effective_multipv()
         else:
             level = work.level
             visits = 1 << 20  # bounded by movetime, not visits
             movetime = level.movetime_ms() / 1000.0
+            multipv = 1
 
         try:
             result = await self.service.search(
-                position.root_fen, position.moves, visits, movetime
+                position.root_fen, position.moves, visits, movetime,
+                multipv=multipv,
             )
         except EngineError:
             raise
@@ -203,8 +227,12 @@ class AzMctsEngine(Engine):
         scores = Matrix()
         pvs = Matrix()
         depth = max(1, result.depth)
-        scores.set(1, depth, Score.cp(result.cp))
-        pvs.set(1, depth, result.pv)
+        for line in result.lines or []:
+            scores.set(line.multipv, depth, Score.cp(line.cp))
+            pvs.set(line.multipv, depth, line.pv)
+        if not result.lines:
+            scores.set(1, depth, Score.cp(result.cp))
+            pvs.set(1, depth, result.pv)
         nodes = result.visits * NODES_PER_VISIT  # protocol-comparable scale
         nps = int(nodes / result.time_seconds) if result.time_seconds > 0 else None
         return PositionResponse(
@@ -215,9 +243,35 @@ class AzMctsEngine(Engine):
         )
 
 
+class _VariantRoutingEngine(Engine):
+    """Serves standard positions with az-mcts and variant positions with
+    the fallback engine (HCE alpha-beta), mirroring the reference where
+    play/variant work runs on Fairy-Stockfish while the analysis engine
+    differs (src/queue.rs:530-539)."""
+
+    def __init__(self, az: Engine, fallback: Engine) -> None:
+        self.az = az
+        self.fallback = fallback
+
+    async def go(self, position: Position) -> PositionResponse:
+        if position.variant is Variant.STANDARD:
+            return await self.az.go(position)
+        return await self.fallback.go(position)
+
+    async def close(self) -> None:
+        await self.az.close()
+        await self.fallback.close()
+
+
 class AzMctsEngineFactory(EngineFactory):
-    def __init__(self, service: AzMctsService) -> None:
+    def __init__(self, service: AzMctsService,
+                 variant_fallback: Optional[EngineFactory] = None) -> None:
         self.service = service
+        self.variant_fallback = variant_fallback
 
     async def create(self, flavor: EngineFlavor) -> Engine:
-        return AzMctsEngine(self.service, flavor)
+        az = AzMctsEngine(self.service, flavor)
+        if self.variant_fallback is None:
+            return az
+        fallback = await self.variant_fallback.create(flavor)
+        return _VariantRoutingEngine(az, fallback)

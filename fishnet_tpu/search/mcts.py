@@ -29,7 +29,7 @@ from fishnet_tpu.chess.board import Board
 from fishnet_tpu.models.az import AzConfig, az_forward, value_to_centipawns
 from fishnet_tpu.models.az_encoding import board_planes, legal_policy_indices
 
-__all__ = ["MctsConfig", "MctsPool", "MctsResult"]
+__all__ = ["MctsConfig", "MctsLine", "MctsPool", "MctsResult"]
 
 
 @dataclass(frozen=True)
@@ -43,6 +43,15 @@ class MctsConfig:
 
 
 @dataclass
+class MctsLine:
+    multipv: int  # 1-based rank
+    move: str
+    value: float
+    cp: int
+    pv: List[str]
+
+
+@dataclass
 class MctsResult:
     best_move: Optional[str]
     pv: List[str]
@@ -51,6 +60,7 @@ class MctsResult:
     visits: int
     depth: int  # principal-variation length
     time_seconds: float
+    lines: List[MctsLine] = field(default_factory=list)
 
 
 PENDING_CHILD = -2  # edge has an evaluation in flight
@@ -84,9 +94,11 @@ def _terminal_value(outcome: int) -> Optional[float]:
 class _Search:
     """One PUCT tree. Nodes live in a list; edges hold child ids."""
 
-    def __init__(self, board: Board, visits: int, cfg: MctsConfig) -> None:
+    def __init__(self, board: Board, visits: int, cfg: MctsConfig,
+                 multipv: int = 1) -> None:
         self.root_board = board
         self.cfg = cfg
+        self.multipv = max(1, multipv)
         self.budget = max(1, visits)
         self.nodes: List[_Node] = []
         self.started = time.monotonic()
@@ -245,27 +257,48 @@ class _Search:
                 value = self.nodes[0].terminal
             return MctsResult(None, [], value, value_to_centipawns(value),
                               self.visits_done, 0, elapsed)
-        pv: List[str] = []
-        node_id = 0
-        while node_id >= 0 and node_id < len(self.nodes):
-            node = self.nodes[node_id]
-            if not node.moves or node.n.sum() == 0:
-                break
-            edge = int(np.argmax(node.n))
-            pv.append(node.moves[edge])
-            node_id = int(node.child[edge])
         root = self.nodes[0]
-        best_edge = int(np.argmax(root.n))
-        n = root.n[best_edge]
-        value = float(root.w[best_edge] / n) if n > 0 else 0.0
+
+        def edge_pv(first_edge: int) -> List[str]:
+            pv = [root.moves[first_edge]]
+            node_id = int(root.child[first_edge])
+            while 0 <= node_id < len(self.nodes):
+                node = self.nodes[node_id]
+                if not node.moves or node.n.sum() == 0:
+                    break
+                edge = int(np.argmax(node.n))
+                pv.append(node.moves[edge])
+                node_id = int(node.child[edge])
+            return pv
+
+        def edge_value(edge: int) -> float:
+            n = root.n[edge]
+            # Zero-visit fallback (stopped early): neutral value; the
+            # ordering below falls back to the policy prior.
+            return float(root.w[edge] / n) if n > 0 else 0.0
+
+        # Rank edges by visits, tie-broken by prior — at zero visits
+        # everywhere (stopped before the first backup) this degrades to
+        # the raw policy ordering instead of move-generation order.
+        order = np.lexsort((root.priors, root.n))[::-1]
+        k = min(self.multipv, len(root.moves))
+        lines = []
+        for rank, edge in enumerate(order[:k], start=1):
+            v = edge_value(int(edge))
+            lines.append(MctsLine(
+                multipv=rank, move=root.moves[int(edge)], value=v,
+                cp=value_to_centipawns(v), pv=edge_pv(int(edge)),
+            ))
+        best = lines[0]
         return MctsResult(
-            best_move=root.moves[best_edge],
-            pv=pv,
-            value=value,
-            cp=value_to_centipawns(value),
+            best_move=best.move,
+            pv=best.pv,
+            value=best.value,
+            cp=best.cp,
             visits=self.visits_done,
-            depth=len(pv),
+            depth=len(best.pv),
             time_seconds=elapsed,
+            lines=lines,
         )
 
 
@@ -285,6 +318,7 @@ class MctsPool:
         self._forward = jax.jit(lambda p, x: az_forward(p, x, cfg.az))
         self._searches: Dict[int, _Search] = {}
         self._next_id = 0
+        self._rr_cursor = 0
         self._lock = threading.Lock()
 
     def warmup(self) -> None:
@@ -293,11 +327,12 @@ class MctsPool:
         logits, values = self._forward(self.params, planes)
         np.asarray(values)
 
-    def submit(self, fen: str, moves: List[str], visits: int) -> int:
+    def submit(self, fen: str, moves: List[str], visits: int,
+               multipv: int = 1) -> int:
         board = Board(fen)
         for m in moves:
             board.push_uci(m)
-        search = _Search(board, visits, self.cfg)
+        search = _Search(board, visits, self.cfg, multipv=multipv)
         with self._lock:
             sid = self._next_id
             self._next_id += 1
@@ -315,16 +350,30 @@ class MctsPool:
         leaves evaluated (0 when all searches are done/idle)."""
         with self._lock:
             searches = list(self._searches.values())
+            start = self._rr_cursor
+        # Rotate the service order so over-capacity steps don't starve
+        # late-submitted searches (head-of-line fairness, like the fiber
+        # pool's rr_cursor).
+        searches = searches[start % max(1, len(searches)):] + \
+            searches[: start % max(1, len(searches))]
         contributors: List[Tuple[_Search, int]] = []  # (search, leaf count)
         planes_list: List[np.ndarray] = []
         cap = self.cfg.batch_capacity
+        served = 0
         for s in searches:
             if s.done:
+                served += 1
                 continue
-            s.collect(room=cap - len(planes_list))
+            room = cap - len(planes_list)
+            if room <= 0:
+                break
+            s.collect(room=room)
+            served += 1
             if s.pending:
                 contributors.append((s, len(s.pending)))
                 planes_list.extend(item[1] for item in s.pending)
+        with self._lock:
+            self._rr_cursor = (start + max(1, served)) % max(1, len(searches))
 
         if not planes_list:
             return 0
@@ -332,8 +381,9 @@ class MctsPool:
         batch = np.zeros((cap, 8, 8, 19), np.float32)
         batch[: len(planes_list)] = np.stack(planes_list)
         logits, values = self._forward(self.params, batch)
-        logits = np.asarray(logits)
-        values = np.asarray(values)
+        n_used = len(planes_list)
+        logits = np.asarray(logits[:n_used])
+        values = np.asarray(values[:n_used])
 
         cursor = 0
         for s, k in contributors:

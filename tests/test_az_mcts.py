@@ -191,6 +191,78 @@ async def test_az_service_search():
         service.close()
 
 
+async def test_az_service_multipv_and_cancellation():
+    from fishnet_tpu.engine.az_engine import AzMctsService
+
+    params = init_az_params(jax.random.PRNGKey(4), TINY)
+    service = AzMctsService(params, MctsConfig(batch_capacity=64, az=TINY))
+    try:
+        res = await service.search(STARTPOS, [], 64, multipv=3)
+        assert [l.multipv for l in res.lines] == [1, 2, 3]
+        assert len({l.move for l in res.lines}) == 3
+        assert res.lines[0].move == res.best_move
+
+        # Cancellation (worker budget) must stop the underlying search.
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(
+                service.search(STARTPOS, [], visits=10_000_000), timeout=0.3
+            )
+        for _ in range(100):
+            if service.pool.active() == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert service.pool.active() == 0, "cancelled search kept running"
+    finally:
+        service.close()
+
+
+async def test_az_factory_variant_fallback_routing():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from fake_server import FakeServer
+    from test_client_e2e import make_client, wait_for
+
+    from fishnet_tpu.engine.az_engine import AzMctsEngineFactory, AzMctsService
+    from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.search.service import SearchService
+
+    params = init_az_params(jax.random.PRNGKey(5), TINY)
+    az_service = AzMctsService(params, MctsConfig(batch_capacity=64, az=TINY))
+    hce_service = SearchService(
+        weights=NnueWeights.random(seed=0), backend="scalar",
+        pool_slots=16, batch_capacity=64, tt_bytes=8 << 20,
+    )
+    try:
+        async with FakeServer() as server:
+            variant_job = server.lichess.add_analysis_job(
+                moves="e2e4", variant="kingofthehill", nodes=3000
+            )
+            standard_job = server.lichess.add_analysis_job(moves="e2e4", nodes=70_000)
+            client = make_client(
+                server.endpoint, cores=2,
+                engine_factory=AzMctsEngineFactory(
+                    az_service, variant_fallback=TpuNnueEngineFactory(hce_service)
+                ),
+            )
+            await client.start()
+            assert await wait_for(
+                lambda: variant_job in server.lichess.analyses
+                and standard_job in server.lichess.analyses,
+                timeout=60,
+            )
+            await client.stop()
+            assert (
+                server.lichess.analyses[variant_job]["stockfish"]["flavor"]
+                == "classical"
+            )
+    finally:
+        az_service.close()
+        hce_service.close()
+
+
 async def test_az_engine_client_e2e():
     import sys
     from pathlib import Path
