@@ -5,14 +5,18 @@ FROM python:3.11-slim AS builder
 WORKDIR /build
 RUN apt-get update && apt-get install -y --no-install-recommends g++ make && rm -rf /var/lib/apt/lists/*
 COPY cpp/ cpp/
-RUN make -C cpp -j"$(nproc)"
+# Portable CPU-feature tiers, not -march=native: the build container's
+# CPU is not the deployment CPU. The runtime loader detects the host
+# (fishnet_tpu/chess/cpu.py) and picks v3 (AVX2/fast-PEXT) or v2.
+RUN make -C cpp tiers -j"$(nproc)"
 
 FROM python:3.11-slim
 RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
     aiohttp numpy
 WORKDIR /app
 COPY fishnet_tpu/ fishnet_tpu/
-COPY --from=builder /build/cpp/libfishnetcore.so cpp/libfishnetcore.so
+COPY --from=builder /build/cpp/libfishnetcore-v2.so cpp/libfishnetcore-v2.so
+COPY --from=builder /build/cpp/libfishnetcore-v3.so cpp/libfishnetcore-v3.so
 COPY docker-entrypoint.sh /docker-entrypoint.sh
 RUN chmod +x /docker-entrypoint.sh
 CMD ["/docker-entrypoint.sh"]

@@ -14,6 +14,7 @@ if [ -n "$MAX_BACKOFF" ]; then args+=("--max-backoff" "$MAX_BACKOFF"); fi
 if [ -n "$ENGINE" ]; then args+=("--engine" "$ENGINE"); fi
 if [ -n "$ENGINE_EXE" ]; then args+=("--engine-exe" "$ENGINE_EXE"); fi
 if [ -n "$NNUE_FILE" ]; then args+=("--nnue-file" "$NNUE_FILE"); fi
+if [ -n "$AZ_NET_FILE" ]; then args+=("--az-net-file" "$AZ_NET_FILE"); fi
 if [ -n "$MICROBATCH" ]; then args+=("--microbatch" "$MICROBATCH"); fi
 
 exec python -m fishnet_tpu "${args[@]}"

@@ -41,12 +41,48 @@ def _build() -> None:
             text=True,
         )
     except (subprocess.CalledProcessError, OSError) as err:
-        if _LIB_PATH.exists():
-            return  # no toolchain here; fall back to the prebuilt library
+        # No toolchain (packaged deployment): fall back to whatever
+        # prebuilt library _select_library finds — native or a CPU tier.
+        # Only surface the build error when nothing loadable exists.
+        candidates = [_LIB_PATH, *_CPP_DIR.glob("libfishnetcore-*.so")]
+        if any(p.exists() for p in candidates):
+            return
         stderr = getattr(err, "stderr", "") or str(err)
         raise NativeCoreError(
             f"failed to build native core: {stderr[-2000:]}"
         ) from err
+
+
+def _select_library() -> Path:
+    """Pick the library to load. Precedence: FISHNET_TPU_CORE_LIB env >
+    host-built -march=native library > best CPU-feature tier (v3 with
+    fast PEXT, else v2 — mirroring the reference's tier selection and
+    AMD slow-PEXT heuristic, assets.rs:86-126)."""
+    override = os.environ.get("FISHNET_TPU_CORE_LIB")
+    if override:
+        path = Path(override)
+        if not path.exists():
+            raise NativeCoreError(
+                f"FISHNET_TPU_CORE_LIB points to a missing file: {override}"
+            )
+        return path
+    if _LIB_PATH.exists():
+        return _LIB_PATH
+    from fishnet_tpu.chess.cpu import detect
+
+    tier = detect().best_tier()
+    if tier:
+        tiered = _CPP_DIR / f"libfishnetcore-{tier}.so"
+        if tiered.exists():
+            return tiered
+        if tier == "v3":
+            fallback = _CPP_DIR / "libfishnetcore-v2.so"
+            if fallback.exists():
+                return fallback
+    raise NativeCoreError(
+        "no native core library found (build with `make -C cpp` or ship "
+        "`make tiers` artifacts)"
+    )
 
 
 def load() -> ctypes.CDLL:
@@ -56,7 +92,7 @@ def load() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         _build()
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib = ctypes.CDLL(str(_select_library()))
 
         lib.fc_init.restype = ctypes.c_int
         lib.fc_variant_supported.argtypes = [ctypes.c_int]

@@ -1,0 +1,86 @@
+"""CPU feature detection and native-library tier selection (the
+reference's assets.rs tier cascade + AMD slow-PEXT heuristic)."""
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from fishnet_tpu.chess.cpu import CpuInfo, parse_cpuinfo
+
+CPP_DIR = Path(__file__).resolve().parent.parent / "cpp"
+
+INTEL_V3 = """\
+vendor_id\t: GenuineIntel
+cpu family\t: 6
+flags\t\t: fpu sse4_1 sse4_2 popcnt avx avx2 bmi1 bmi2
+"""
+
+AMD_ZEN2 = """\
+vendor_id\t: AuthenticAMD
+cpu family\t: 23
+flags\t\t: fpu sse4_1 sse4_2 popcnt avx avx2 bmi1 bmi2
+"""
+
+AMD_ZEN3 = """\
+vendor_id\t: AuthenticAMD
+cpu family\t: 25
+flags\t\t: fpu sse4_1 sse4_2 popcnt avx avx2 bmi1 bmi2
+"""
+
+OLD_BOX = """\
+vendor_id\t: GenuineIntel
+cpu family\t: 6
+flags\t\t: fpu sse2 sse4_1 sse4_2 popcnt
+"""
+
+
+def test_intel_gets_v3():
+    info = parse_cpuinfo(INTEL_V3)
+    assert info.fast_pext
+    assert info.best_tier() == "v3"
+
+
+def test_amd_zen2_pext_demoted_to_v2():
+    # BMI2 present but microcoded: the reference demotes exactly this
+    # case (assets.rs:94-108).
+    info = parse_cpuinfo(AMD_ZEN2)
+    assert not info.fast_pext
+    assert info.best_tier() == "v2"
+
+
+def test_amd_zen3_gets_v3():
+    info = parse_cpuinfo(AMD_ZEN3)
+    assert info.fast_pext
+    assert info.best_tier() == "v3"
+
+
+def test_old_cpu_gets_v2():
+    assert parse_cpuinfo(OLD_BOX).best_tier() == "v2"
+
+
+def test_unknown_cpu_gets_none():
+    assert CpuInfo().best_tier() is None
+
+
+@pytest.mark.slow
+def test_tier_builds_load_and_pass_perft():
+    import platform
+
+    if platform.machine() not in ("x86_64", "AMD64"):
+        pytest.skip("x86-64 tier builds")
+    subprocess.run(["make", "-C", str(CPP_DIR), "tiers", "-j2"], check=True,
+                   capture_output=True)
+    for tier in ("v2", "v3"):
+        lib = ctypes.CDLL(str(CPP_DIR / f"libfishnetcore-{tier}.so"))
+        lib.fc_init()
+        err = ctypes.create_string_buffer(256)
+        lib.fc_pos_new.restype = ctypes.c_void_p
+        pos = lib.fc_pos_new(
+            b"rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+            0, err, 256,
+        )
+        assert pos
+        lib.fc_perft.restype = ctypes.c_uint64
+        assert lib.fc_perft(ctypes.c_void_p(pos), 4) == 197281
