@@ -34,6 +34,12 @@ bool variant_supported(int variant) {
 
 extern "C" {
 
+// ABI version of this ctypes surface. Bump on ANY exported-signature
+// change; the Python binder refuses mismatched libraries (a stale
+// prebuilt tier .so with an old layout would otherwise corrupt memory
+// through shifted arguments).
+int fc_abi_version() { return 2; }
+
 int fc_init() {
   init_bitboards();
   init_zobrist();

@@ -117,33 +117,41 @@ struct SearchPool {
   std::unique_ptr<ScalarEval> scalar_eval;
   HceEval hce_eval;  // variant searches (immediate, CPU)
   std::vector<std::unique_ptr<Slot>> slots;
-  // (slot id, index within the slot's block) per entry of the last
-  // step()'s eval batch, in emission order.
-  std::vector<std::pair<int, int>> last_batch;
+  // Slots are partitioned into n_groups (slot id mod n_groups) so the
+  // driver can keep several device batches in flight: step/provide act
+  // on one group while other groups' evals ride the wire. Each group
+  // keeps its own emission record and fairness cursor.
+  int n_groups = 1;
+  // (slot id, index within the slot's block) per entry of the group's
+  // last step() eval batch, in emission order.
+  std::vector<std::vector<std::pair<int, int>>> group_batch;
   std::deque<int> finished_queue;
-  // Round-robin scan origin: each step starts scanning just past the
-  // last slot served, so over-capacity steps rotate service instead of
-  // starving high-index slots (head-of-line fairness).
-  size_t rr_cursor = 0;
+  // Round-robin scan origin per group: each step starts scanning just
+  // past the last slot served, so over-capacity steps rotate service
+  // instead of starving high-index slots (head-of-line fairness).
+  std::vector<size_t> group_cursor;
   // Worst case per fiber.h's sizing analysis (MAX_PLY frames + qsearch
   // tail at ~2.5 KB/frame): needs the full 512 KB; pages commit lazily.
   size_t fiber_stack = 512 * 1024;
 
-  SearchPool(int max_slots, size_t tt_bytes) : tt(tt_bytes) {
+  SearchPool(int max_slots, size_t tt_bytes, int groups) : tt(tt_bytes) {
     slots.resize(max_slots);
     for (auto& s : slots) s = std::make_unique<Slot>();
+    n_groups = groups < 1 ? 1 : (groups > max_slots ? max_slots : groups);
+    group_batch.resize(n_groups);
+    group_cursor.assign(n_groups, 0);
   }
 };
 
 extern "C" {
 
 SearchPool* fc_pool_new(int max_slots, uint64_t tt_bytes,
-                        const char* scalar_net_path) {
+                        const char* scalar_net_path, int n_groups) {
   init_bitboards();
   init_zobrist();
   auto* pool = new (std::nothrow) SearchPool(
       max_slots > 0 ? max_slots : 256,
-      tt_bytes ? size_t(tt_bytes) : (64ull << 20));
+      tt_bytes ? size_t(tt_bytes) : (64ull << 20), n_groups);
   if (!pool) return nullptr;
   if (scalar_net_path && scalar_net_path[0]) {
     pool->scalar_net = std::make_unique<NnueNet>();
@@ -231,13 +239,14 @@ void fc_pool_stop(SearchPool* pool, int slot_id) {
 // fiber is waiting for evals (check fc_pool_finished for results).
 namespace {
 
-// Append slot i's whole eval block to the outgoing batch if it fits.
-// Features go out as uint16 (22528 fits): half the bytes across the
-// host->device link, which is a scarce resource.
-bool emit_block(SearchPool* pool, int i, uint16_t* out_features,
-                int32_t* out_buckets, int32_t* out_slots, int capacity) {
+// Append slot i's whole eval block to the group's outgoing batch if it
+// fits. Features go out as uint16 (22528 fits): half the bytes across
+// the host->device link, which is a scarce resource.
+bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
+                int i, uint16_t* out_features, int32_t* out_buckets,
+                int32_t* out_slots, int capacity) {
   Slot& slot = *pool->slots[i];
-  int base = int(pool->last_batch.size());
+  int base = int(batch.size());
   if (base + slot.block_n > capacity) return false;  // wait for next step
   for (int j = 0; j < slot.block_n; j++) {
     int idx = base + j;
@@ -245,38 +254,45 @@ bool emit_block(SearchPool* pool, int i, uint16_t* out_features,
            &slot.features[j][0][0], sizeof(uint16_t) * 2 * NNUE_MAX_ACTIVE);
     out_buckets[idx] = slot.buckets[j];
     out_slots[idx] = i;
-    pool->last_batch.emplace_back(i, j);
+    batch.emplace_back(i, j);
   }
   return true;
 }
 
 }  // namespace
 
-int fc_pool_step(SearchPool* pool, uint16_t* out_features, int32_t* out_buckets,
-                 int32_t* out_slots, int capacity) {
-  pool->last_batch.clear();
+int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
+                 int32_t* out_buckets, int32_t* out_slots, int capacity) {
+  if (group < 0 || group >= pool->n_groups) group = 0;
+  auto& batch = pool->group_batch[group];
+  batch.clear();
   const size_t n_slots = pool->slots.size();
+  const int n_groups = pool->n_groups;
+  size_t cursor = pool->group_cursor[group];
 
   // Phase 1: fibers still suspended from a previous over-capacity step
   // have waited longest — serve them before any freshly-produced blocks
   // can refill the batch.
   for (size_t k = 0; k < n_slots; k++) {
-    size_t i = (pool->rr_cursor + k) % n_slots;
+    size_t i = (cursor + k) % n_slots;
+    if (int(i) % n_groups != group) continue;
     Slot& slot = *pool->slots[i];
     if (!slot.active || slot.finished || !slot.wants_eval) continue;
-    emit_block(pool, int(i), out_features, out_buckets, out_slots, capacity);
+    emit_block(pool, batch, int(i), out_features, out_buckets, out_slots,
+               capacity);
   }
 
   // Phase 2: run every runnable fiber to its next leaf; emit the blocks
   // they produce as long as they fit. (Slots emitted in phase 1 still
   // have wants_eval set and are skipped here.)
   for (size_t k = 0; k < n_slots; k++) {
-    size_t i = (pool->rr_cursor + k) % n_slots;
+    size_t i = (cursor + k) % n_slots;
+    if (int(i) % n_groups != group) continue;
     Slot& slot = *pool->slots[i];
     if (!slot.active || slot.finished || slot.wants_eval) continue;
 
     if (!slot.started) {
-      if (int(pool->last_batch.size()) >= capacity) continue;  // defer launch
+      if (int(batch.size()) >= capacity) continue;  // defer launch
       slot.started = true;
       Slot* sp = &slot;
       SearchPool* pp = pool;
@@ -298,30 +314,33 @@ int fc_pool_step(SearchPool* pool, uint16_t* out_features, int32_t* out_buckets,
       slot.finished = true;
       pool->finished_queue.push_back(int(i));
     } else if (slot.wants_eval) {
-      emit_block(pool, int(i), out_features, out_buckets, out_slots, capacity);
+      emit_block(pool, batch, int(i), out_features, out_buckets, out_slots,
+                 capacity);
       // Blocks that don't fit stay suspended; phase 1 of the next step
       // picks them up first.
     }
   }
 
   // Rotate: next step starts scanning just past the last slot served.
-  if (!pool->last_batch.empty())
-    pool->rr_cursor = (size_t(pool->last_batch.back().first) + 1) % n_slots;
+  if (!batch.empty())
+    pool->group_cursor[group] = (size_t(batch.back().first) + 1) % n_slots;
 
-  return int(pool->last_batch.size());
+  return int(batch.size());
 }
 
-// Provide centipawn scores for the last step()'s batch, in order.
-// A fiber resumes (on the next fc_pool_step) once its whole block has
-// values; the service always provides all n requested.
-void fc_pool_provide(SearchPool* pool, const int32_t* values, int n) {
-  for (int i = 0; i < n && i < int(pool->last_batch.size()); i++) {
-    auto [sid, bidx] = pool->last_batch[i];
+// Provide centipawn scores for the group's last step() batch, in order.
+// A fiber resumes (on the group's next fc_pool_step) once its whole
+// block has values; the service always provides all n requested.
+void fc_pool_provide(SearchPool* pool, int group, const int32_t* values, int n) {
+  if (group < 0 || group >= pool->n_groups) group = 0;
+  auto& batch = pool->group_batch[group];
+  for (int i = 0; i < n && i < int(batch.size()); i++) {
+    auto [sid, bidx] = batch[i];
     Slot& slot = *pool->slots[sid];
     slot.eval_values[bidx] = values[i];
     if (bidx == slot.block_n - 1) slot.wants_eval = false;  // runnable again
   }
-  pool->last_batch.clear();
+  batch.clear();
 }
 
 // Number of slots still working (active and not finished).

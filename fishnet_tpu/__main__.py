@@ -64,12 +64,19 @@ def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
         from fishnet_tpu.nnue.weights import NnueWeights
         from fishnet_tpu.search.service import SearchService
 
+        depth = opt.pipeline or 1
         if opt.nnue_file:
-            service = SearchService(net_path=opt.nnue_file, batch_capacity=opt.resolved_microbatch())
+            service = SearchService(
+                net_path=opt.nnue_file,
+                batch_capacity=opt.resolved_microbatch(),
+                pipeline_depth=depth,
+            )
         else:
             logger.warn("No --nnue-file given; using random NNUE weights (dev mode).")
             service = SearchService(
-                weights=NnueWeights.random(seed=0), batch_capacity=opt.resolved_microbatch()
+                weights=NnueWeights.random(seed=0),
+                batch_capacity=opt.resolved_microbatch(),
+                pipeline_depth=depth,
             )
         return TpuNnueEngineFactory(service)
     if engine == "az-mcts":

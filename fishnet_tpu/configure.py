@@ -172,6 +172,7 @@ class Opt:
     nnue_file: Optional[str] = None
     az_net_file: Optional[str] = None
     microbatch: Optional[int] = None
+    pipeline: Optional[int] = None
 
     def conf_path(self) -> Path:
         return Path(self.conf) if self.conf else Path("fishnet.ini")
@@ -231,6 +232,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--microbatch", type=int, default=None, help="TPU eval microbatch size (default 1024).")
     p.add_argument("--az-net-file", default=None,
                    help="Policy+value net checkpoint (.npz) for --engine az-mcts.")
+    p.add_argument("--pipeline", type=int, default=None,
+                   help="Eval pipeline depth (in-flight device batches). Default 1; "
+                        "raise to 2-4 on locally attached TPUs.")
     return p
 
 
@@ -264,6 +268,10 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
         if ns.microbatch < 1:
             raise ConfigError("--microbatch must be >= 1")
         opt.microbatch = ns.microbatch
+    if ns.pipeline is not None:
+        if ns.pipeline < 1:
+            raise ConfigError("--pipeline must be >= 1")
+        opt.pipeline = ns.pipeline
     return opt
 
 

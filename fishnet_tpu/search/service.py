@@ -59,7 +59,9 @@ class _Pending:
 def _bind_pool_api(lib: ctypes.CDLL) -> None:
     if getattr(lib, "_pool_bound", False):
         return
-    lib.fc_pool_new.argtypes = [ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p]
+    lib.fc_pool_new.argtypes = [
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int,
+    ]
     lib.fc_pool_new.restype = ctypes.c_void_p
     lib.fc_pool_free.argtypes = [ctypes.c_void_p]
     lib.fc_pool_submit.argtypes = [
@@ -70,13 +72,14 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib.fc_pool_submit.restype = ctypes.c_int
     lib.fc_pool_stop.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.fc_pool_step.argtypes = [
-        ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
     ]
     lib.fc_pool_step.restype = ctypes.c_int
     lib.fc_pool_provide.argtypes = [
-        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
     ]
     lib.fc_pool_active.argtypes = [ctypes.c_void_p]
     lib.fc_pool_active.restype = ctypes.c_int
@@ -118,6 +121,7 @@ class SearchService:
         tt_bytes: int = 64 << 20,
         backend: str = "jax",  # "jax" | "scalar"
         eval_sizes: Optional[Sequence[int]] = None,
+        pipeline_depth: int = 1,
     ) -> None:
         self._lib = load()
         _bind_pool_api(self._lib)
@@ -133,11 +137,25 @@ class SearchService:
         self.net_path = str(net_path)
         self.backend = backend
         self.batch_capacity = batch_capacity = max(batch_capacity, MIN_BATCH_CAPACITY)
+        # Pipeline depth: the pool's slots are partitioned into this many
+        # groups, each with its own in-flight device batch. While group
+        # i's eval rides the host<->device link, groups i+1.. run their
+        # fibers — overlapping CPU search, transfer, and device compute.
+        # Depth 1 (default) is the serial loop: one full-width batch per
+        # round trip, which measures fastest when the transport is a
+        # latency-dominated serialized link (remote/tunneled devices —
+        # each RPC costs ~the same regardless of size, so k smaller
+        # batches take ~k round trips). Raise to 2-4 on locally attached
+        # TPUs, where dispatch is genuinely asynchronous and the groups
+        # overlap host search, PCIe transfer, and device compute.
+        self.pipeline_depth = (
+            1 if backend == "scalar" else max(1, min(pipeline_depth, pool_slots))
+        )
 
         # The scalar net is always loaded into the pool: it serves the
         # "scalar" backend and is the fallback if JAX is unusable.
         self._pool = self._lib.fc_pool_new(
-            pool_slots, tt_bytes, self.net_path.encode()
+            pool_slots, tt_bytes, self.net_path.encode(), self.pipeline_depth
         )
         if not self._pool:
             raise NativeCoreError("failed to create search pool")
@@ -155,25 +173,35 @@ class SearchService:
 
         # Driver state. Buffers must exist before the thread starts.
         cap = batch_capacity
+        # Each pipeline group steps at most cap/k leaves so the k groups
+        # together still fill one batch_capacity of in-flight work —
+        # without this, k groups each padding up to the full capacity
+        # bucket would multiply the host->device bytes by k.
+        self._group_capacity = max(MIN_BATCH_CAPACITY, cap // self.pipeline_depth)
         # Shape buckets for _evaluate. Each distinct size is one XLA
         # compile (slow through a device tunnel) — callers with a known
         # steady-state load should pass just two or three sizes.
         if eval_sizes is not None:
-            sizes = sorted({min(int(s), cap) for s in eval_sizes if s > 0})
-            if not sizes or sizes[-1] != cap:
-                sizes.append(cap)
-            self._eval_sizes = sizes
+            sizes = {min(int(s), cap) for s in eval_sizes if s > 0}
+            sizes.add(cap)
+            sizes.add(self._group_capacity)  # groups fill to this bucket
+            self._eval_sizes = sorted(sizes)
         else:
-            self._eval_sizes = []
+            sizes = set()
             s = 64
             while s < cap:
-                self._eval_sizes.append(s)
+                sizes.add(s)
                 s *= 2
-            self._eval_sizes.append(cap)
+            sizes.add(cap)
+            sizes.add(self._group_capacity)  # groups fill to this bucket
+            self._eval_sizes = sorted(sizes)
         # uint16 feature indices: half the host->device transfer bytes.
-        self._feat_buf = np.empty((cap, 2, spec.MAX_ACTIVE_FEATURES), dtype=np.uint16)
-        self._bucket_buf = np.empty((cap,), dtype=np.int32)
-        self._slot_buf = np.empty((cap,), dtype=np.int32)
+        # One buffer set per pipeline group: group i's buffers must stay
+        # untouched while its dispatched eval is still in flight.
+        k = self.pipeline_depth
+        self._feat_buf = np.empty((k, cap, 2, spec.MAX_ACTIVE_FEATURES), dtype=np.uint16)
+        self._bucket_buf = np.empty((k, cap), dtype=np.int32)
+        self._slot_buf = np.empty((k, cap), dtype=np.int32)
         self._pending: Dict[int, _Pending] = {}
         self._submissions: List[Tuple] = []
         self._stop_requests: List[Tuple[int, _Pending]] = []
@@ -263,24 +291,31 @@ class SearchService:
 
     # -- evaluation -------------------------------------------------------
 
-    def _evaluate(self, n: int) -> np.ndarray:
-        if self._eval_fn is None:
-            raise NativeCoreError("no evaluator")  # pragma: no cover
-        # Size-bucketed shapes: ship the smallest power-of-two slice that
-        # covers n. Each bucket compiles once; a lightly-loaded step then
-        # transfers KBs, not the full batch_capacity buffer (the
-        # host->device link is the bottleneck resource).
+    def _dispatch_eval(self, group: int, n: int):
+        """Launch group `group`'s microbatch on the device WITHOUT waiting
+        for the result — the returned jax array is resolved later by
+        _resolve_eval, letting other groups' batches overlap this one's
+        transfer and compute (the software pipeline's whole point).
+
+        Size-bucketed shapes: ship the smallest power-of-two slice that
+        covers n. Each bucket compiles once; a lightly-loaded step then
+        transfers KBs, not the full batch_capacity buffer (the
+        host->device link is the bottleneck resource)."""
         size = self._eval_sizes[-1]
         for s in self._eval_sizes:
             if n <= s:
                 size = s
                 break
-        self._feat_buf[n:size] = spec.NUM_FEATURES
-        self._bucket_buf[n:size] = 0
-        values = np.asarray(
-            self._eval_fn(self._params, self._feat_buf[:size], self._bucket_buf[:size])
-        )
-        return values[:n].astype(np.int32)
+        feats = self._feat_buf[group]
+        buckets = self._bucket_buf[group]
+        feats[n:size] = spec.NUM_FEATURES
+        buckets[n:size] = 0
+        return self._eval_fn(self._params, feats[:size], buckets[:size])
+
+    def _resolve_eval(self, n: int, arr) -> np.ndarray:
+        """Block until a dispatched eval is done; contiguous int32 [n]."""
+        values = np.asarray(arr)
+        return np.ascontiguousarray(values[:n], dtype=np.int32)
 
     # -- driver thread ----------------------------------------------------
 
@@ -295,9 +330,26 @@ class SearchService:
     def _drive_inner(self) -> None:
         lib = self._lib
         cap = self.batch_capacity
-        feat_ptr = self._feat_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
-        bucket_ptr = self._bucket_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-        slot_ptr = self._slot_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        k = self.pipeline_depth
+        feat_ptrs = [
+            self._feat_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+            for g in range(k)
+        ]
+        bucket_ptrs = [
+            self._bucket_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            for g in range(k)
+        ]
+        slot_ptrs = [
+            self._slot_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            for g in range(k)
+        ]
+        # In-flight device evals per group: group -> (n, dispatched array).
+        # The software pipeline: resolve group g's previous eval (blocks
+        # only on the oldest dispatch), wake its fibers, step them to new
+        # leaves, dispatch the next eval — then move to group g+1 while
+        # this one rides the host<->device link. With k groups up to k
+        # batches overlap CPU search, transfer, and device compute.
+        inflight: Dict[int, Tuple[int, object]] = {}
 
         # Compile every eval-size bucket up front, on this thread: a
         # first-touch XLA compile mid-traffic would stall every in-flight
@@ -347,14 +399,26 @@ class SearchService:
                         loop.call_later, movetime, self._maybe_stop, slot, pending
                     )
 
-            # Advance fibers to their leaves; fill the eval batch.
-            n = lib.fc_pool_step(self._pool, feat_ptr, bucket_ptr, slot_ptr, cap)
-            if n > 0:
-                values = self._evaluate(n)
-                arr = np.ascontiguousarray(values, dtype=np.int32)
-                lib.fc_pool_provide(
-                    self._pool, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n
+            stepped = 0
+            for g in range(k):
+                if g in inflight:
+                    n_prev, arr = inflight.pop(g)
+                    values = self._resolve_eval(n_prev, arr)
+                    lib.fc_pool_provide(
+                        self._pool, g,
+                        values.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                        n_prev,
+                    )
+                # Advance this group's fibers; fill its eval batch.
+                n = lib.fc_pool_step(
+                    self._pool, g, feat_ptrs[g], bucket_ptrs[g], slot_ptrs[g],
+                    self._group_capacity,
                 )
+                stepped += n
+                if n > 0:
+                    if self._eval_fn is None:
+                        raise NativeCoreError("no evaluator")  # pragma: no cover
+                    inflight[g] = (n, self._dispatch_eval(g, n))
 
             # Harvest finished searches.
             while True:
@@ -363,7 +427,7 @@ class SearchService:
                     break
                 self._finish_slot(slot)
 
-            if n == 0 and lib.fc_pool_active(self._pool) == 0:
+            if stepped == 0 and not inflight and lib.fc_pool_active(self._pool) == 0:
                 with self._lock:
                     idle = not self._submissions and not self._stopping
                 if idle:
