@@ -9,6 +9,23 @@ two-phase signal handling (first SIGINT drains, second aborts) and the
 
 from __future__ import annotations
 
+import os as _os
+
+# SSLKEYLOGFILE is applied globally by CPython's ssl module (and thus by
+# aiohttp at import time); an unopenable path would otherwise crash the
+# process inside `import aiohttp`. Validate early and degrade to a
+# warning, matching the reference's rustls KeyLogFile behavior.
+_keylog = _os.environ.get("SSLKEYLOGFILE")
+if _keylog:
+    try:
+        with open(_keylog, "a"):
+            pass
+    except OSError as _err:
+        import sys as _sys
+
+        _sys.stderr.write(f"W: Ignoring unopenable SSLKEYLOGFILE {_keylog!r}: {_err}\n")
+        del _os.environ["SSLKEYLOGFILE"]
+
 import asyncio
 import signal
 import sys

@@ -24,6 +24,12 @@ _LIB_PATH = _CPP_DIR / "libfishnetcore.so"
 _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()
 
+#: Expected C ABI version (cpp/src/capi.cpp fc_abi_version). A library
+#: built from different-era sources must be rejected, not loaded: ctypes
+#: has no signature checking, so a mismatched argument layout corrupts
+#: memory silently.
+ABI_VERSION = 2
+
 
 class NativeCoreError(RuntimeError):
     pass
@@ -53,11 +59,13 @@ def _build() -> None:
         ) from err
 
 
-def _select_library() -> Path:
-    """Pick the library to load. Precedence: FISHNET_TPU_CORE_LIB env >
+def _candidate_libraries() -> list:
+    """Libraries to try, best first: FISHNET_TPU_CORE_LIB env >
     host-built -march=native library > best CPU-feature tier (v3 with
-    fast PEXT, else v2 — mirroring the reference's tier selection and
-    AMD slow-PEXT heuristic, assets.rs:86-126)."""
+    fast PEXT, then v2 — mirroring the reference's tier selection and
+    AMD slow-PEXT heuristic, assets.rs:86-126). Later candidates are
+    fallbacks for earlier ones that fail the ABI handshake (e.g. a
+    stale host build next to freshly shipped tiers)."""
     override = os.environ.get("FISHNET_TPU_CORE_LIB")
     if override:
         path = Path(override)
@@ -65,24 +73,24 @@ def _select_library() -> Path:
             raise NativeCoreError(
                 f"FISHNET_TPU_CORE_LIB points to a missing file: {override}"
             )
-        return path
+        return [path]  # explicit override: no silent fallback
+    candidates = []
     if _LIB_PATH.exists():
-        return _LIB_PATH
+        candidates.append(_LIB_PATH)
     from fishnet_tpu.chess.cpu import detect
 
     tier = detect().best_tier()
-    if tier:
-        tiered = _CPP_DIR / f"libfishnetcore-{tier}.so"
-        if tiered.exists():
-            return tiered
-        if tier == "v3":
-            fallback = _CPP_DIR / "libfishnetcore-v2.so"
-            if fallback.exists():
-                return fallback
-    raise NativeCoreError(
-        "no native core library found (build with `make -C cpp` or ship "
-        "`make tiers` artifacts)"
-    )
+    tiers = {"v3": ["v3", "v2"], "v2": ["v2"]}.get(tier, [])
+    for t in tiers:
+        path = _CPP_DIR / f"libfishnetcore-{t}.so"
+        if path.exists():
+            candidates.append(path)
+    if not candidates:
+        raise NativeCoreError(
+            "no native core library found (build with `make -C cpp` or ship "
+            "`make tiers` artifacts)"
+        )
+    return candidates
 
 
 def load() -> ctypes.CDLL:
@@ -92,7 +100,25 @@ def load() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         _build()
-        lib = ctypes.CDLL(str(_select_library()))
+        lib = None
+        mismatches = []
+        for path in _candidate_libraries():
+            candidate = ctypes.CDLL(str(path))
+            try:
+                candidate.fc_abi_version.restype = ctypes.c_int
+                abi = candidate.fc_abi_version()
+            except AttributeError:
+                abi = -1
+            if abi == ABI_VERSION:
+                lib = candidate
+                break
+            mismatches.append(f"{path} (ABI {abi})")
+        if lib is None:
+            raise NativeCoreError(
+                f"no native core with ABI version {ABI_VERSION} found; "
+                f"rejected: {', '.join(mismatches)} — rebuild with "
+                "`make -C cpp` or ship matching tier libraries"
+            )
 
         lib.fc_init.restype = ctypes.c_int
         lib.fc_variant_supported.argtypes = [ctypes.c_int]

@@ -133,6 +133,11 @@ class ApiActor:
         headers = {"User-Agent": user_agent()}
         if self.key:
             headers["Authorization"] = f"Bearer {self.key}"
+        # SSLKEYLOGFILE (wire inspection, like the reference via rustls,
+        # api.rs:488-502) needs no code here: CPython's
+        # ssl.create_default_context applies the env var to every TLS
+        # context aiohttp builds. __main__ validates the path up front so
+        # a typo degrades to a warning instead of failing at import time.
         return aiohttp.ClientSession(
             headers=headers,
             timeout=aiohttp.ClientTimeout(total=REQUEST_TIMEOUT_SECONDS),

@@ -1,9 +1,13 @@
-"""NNUE training: float model, quantization export, sharded trainer."""
+"""Training subsystems: NNUE (float model + quantization export) and the
+AlphaZero-style policy+value family, both with sharded trainers."""
 
+from fishnet_tpu.train.az_trainer import AzTrainer, AzTrainState
 from fishnet_tpu.train.model import NetConfig, clip_params, forward, init_params, quantize
 from fishnet_tpu.train.trainer import Batch, Trainer, TrainState, batch_specs, param_specs
 
 __all__ = [
+    "AzTrainer",
+    "AzTrainState",
     "Batch",
     "NetConfig",
     "Trainer",

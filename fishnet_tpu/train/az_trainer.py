@@ -1,0 +1,130 @@
+"""Sharded training step for the AlphaZero-style policy+value net.
+
+Companion to trainer.py (the NNUE trainer): one jitted function advances
+(params, opt_state) one step on a sharded microbatch. The conv tower's
+parameters are small relative to its activations, so parallelism is pure
+data-parallel over the ``data`` mesh axis (gradients all-reduce over
+``data``, inserted by XLA); the tower's channel dimension is sharded over
+``model`` only for the stem/residual weights when the mesh has a model
+axis, which keeps the same (data, model) mesh shape the NNUE trainer
+uses so both families train on one mesh layout.
+
+Loss is the AlphaZero recipe: cross-entropy between the policy head and
+MCTS visit-count targets, MSE between the value head and the game
+outcome (or a teacher value), plus weight decay via the optimizer.
+
+The reference has no training subsystem at all (SURVEY.md §2: nets are
+opaque embedded blobs); training being first-class here is what lets the
+framework produce the very nets its engines serve.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fishnet_tpu.models.az import AzConfig, az_forward, init_az_params
+from fishnet_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from fishnet_tpu.train.trainer import _constrain
+
+Batch = Dict[str, jax.Array]
+# keys: planes float32 [B,8,8,19]; policy_target float32 [B,4672]
+#       (normalized visit counts, zero off legal moves);
+#       value_target float32 [B] in [-1, 1].
+
+
+class AzTrainState(NamedTuple):
+    params: Dict[str, jax.Array]
+    opt_state: optax.OptState
+    step: jax.Array
+
+
+def az_param_spec(name: str, value: jax.Array) -> P:
+    """Shard conv kernels' output-channel dim over ``model``; replicate
+    biases and the small heads."""
+    if name.endswith(("_w1", "_w2")) or name == "stem_w":
+        return P(None, None, None, MODEL_AXIS)
+    return P()
+
+
+def az_batch_specs() -> Dict[str, P]:
+    return {
+        "planes": P(DATA_AXIS),
+        "policy_target": P(DATA_AXIS),
+        "value_target": P(DATA_AXIS),
+    }
+
+
+def _constrain_params(params, mesh: Optional[Mesh]):
+    specs = {k: az_param_spec(k, v) for k, v in params.items()}
+    return _constrain(params, specs, mesh)
+
+
+class AzTrainer:
+    """Owns optimizer + jitted step. ``mesh=None`` runs single-device."""
+
+    def __init__(
+        self,
+        cfg: AzConfig = AzConfig(),
+        mesh: Optional[Mesh] = None,
+        learning_rate: float = 2e-3,
+        value_weight: float = 1.0,
+        optimizer: Optional[optax.GradientTransformation] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        self.value_weight = value_weight
+        self.optimizer = optimizer or optax.adamw(learning_rate, weight_decay=1e-4)
+        self._init_jit = jax.jit(self._init)
+        self._step_jit = jax.jit(self._step, donate_argnums=(0,))
+
+    # -- jitted bodies ----------------------------------------------------
+
+    def _init(self, rng: jax.Array) -> AzTrainState:
+        params = init_az_params(rng, self.cfg)
+        params = _constrain_params(params, self.mesh)
+        opt_state = self.optimizer.init(params)
+        return AzTrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    def _loss(self, params, batch: Batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        logits, value = az_forward(params, batch["planes"], self.cfg)
+        target = batch["policy_target"]
+        # Masked cross-entropy: zero-probability targets (illegal moves)
+        # contribute nothing; log-softmax over the full policy space.
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        policy_loss = -jnp.mean(jnp.sum(target * logp, axis=-1))
+        value_loss = jnp.mean((value - batch["value_target"]) ** 2)
+        loss = policy_loss + self.value_weight * value_loss
+        return loss, {
+            "loss": loss,
+            "policy_loss": policy_loss,
+            "value_loss": value_loss,
+        }
+
+    def _step(self, state: AzTrainState, batch: Batch):
+        batch = _constrain(batch, az_batch_specs(), self.mesh)
+        grads, metrics = jax.grad(self._loss, has_aux=True)(state.params, batch)
+        updates, opt_state = self.optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        params = _constrain_params(params, self.mesh)
+        return AzTrainState(params, opt_state, state.step + 1), metrics
+
+    # -- public api -------------------------------------------------------
+
+    def init(self, seed: int = 0) -> AzTrainState:
+        return self._init_jit(jax.random.PRNGKey(seed))
+
+    def step(self, state: AzTrainState, batch: Batch):
+        return self._step_jit(state, batch)
+
+    def export(self, state: AzTrainState, path: str) -> None:
+        """Save params as the .npz checkpoint --az-net-file consumes."""
+        import numpy as np
+
+        np.savez(path, **{k: np.asarray(v) for k, v in state.params.items()})
