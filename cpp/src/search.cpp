@@ -475,7 +475,7 @@ SearchResult Search::run(const Position& root,
     // mate 0 when checkmated, cp 0 when stalemated; protocol.md:99-104).
     PvLine line;
     line.depth = 0;
-    line.mate = root.in_check();
+    line.mate = root.effective_check();
     line.value = 0;
     result.lines.push_back(line);
     result.nodes = 0;
@@ -486,13 +486,43 @@ SearchResult Search::run(const Position& root,
   int multipv = std::min<int>(std::max(1, limits.multipv), root_moves.size);
 
   Move overall_best = MOVE_NONE;
+  int prev_value = 0;
+  bool have_prev = false;
 
   for (int depth = 1; depth <= max_depth && !stopped_; depth++) {
     std::vector<Move> excluded;
     for (int rank = 1; rank <= multipv; rank++) {
       excluded_root_moves_ = excluded;
-      int value = alpha_beta(root, -VALUE_INF, VALUE_INF, depth, 0, true);
+      // Aspiration window around the previous iteration's score (rank 1
+      // only — secondary PVs have no stable anchor). A window miss
+      // widens geometrically and re-searches; the savings from the
+      // narrow bounds buy roughly an extra ply per node budget.
+      int alpha = -VALUE_INF, beta = VALUE_INF;
+      int delta = 18;
+      if (rank == 1 && depth >= 4 && have_prev &&
+          std::abs(prev_value) < VALUE_MATE_IN_MAX) {
+        alpha = std::max(prev_value - delta, -VALUE_INF);
+        beta = std::min(prev_value + delta, VALUE_INF);
+      }
+      int value;
+      while (true) {
+        value = alpha_beta(root, alpha, beta, depth, 0, true);
+        if (stopped_) break;
+        if (value <= alpha && alpha > -VALUE_INF) {
+          alpha = std::max(value - delta, -VALUE_INF);
+          delta *= 3;
+        } else if (value >= beta && beta < VALUE_INF) {
+          beta = std::min(value + delta, VALUE_INF);
+          delta *= 3;
+        } else {
+          break;
+        }
+      }
       if (stopped_ || pv_len_[0] == 0) break;  // discard interrupted search
+      if (rank == 1) {
+        prev_value = value;
+        have_prev = true;
+      }
       PvLine line;
       line.multipv = rank;
       line.depth = depth;

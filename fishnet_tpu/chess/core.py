@@ -48,8 +48,8 @@ def _build() -> None:
         )
     except (subprocess.CalledProcessError, OSError) as err:
         # No toolchain (packaged deployment): fall back to whatever
-        # prebuilt library _select_library finds — native or a CPU tier.
-        # Only surface the build error when nothing loadable exists.
+        # prebuilt library _candidate_libraries finds — native or a CPU
+        # tier. Only surface the build error when nothing loadable exists.
         candidates = [_LIB_PATH, *_CPP_DIR.glob("libfishnetcore-*.so")]
         if any(p.exists() for p in candidates):
             return
@@ -103,7 +103,13 @@ def load() -> ctypes.CDLL:
         lib = None
         mismatches = []
         for path in _candidate_libraries():
-            candidate = ctypes.CDLL(str(path))
+            try:
+                candidate = ctypes.CDLL(str(path))
+            except OSError as err:
+                # Truncated file / wrong arch / missing deps: skip to the
+                # next candidate instead of aborting the fallback chain.
+                mismatches.append(f"{path} (unloadable: {err})")
+                continue
             try:
                 candidate.fc_abi_version.restype = ctypes.c_int
                 abi = candidate.fc_abi_version()
