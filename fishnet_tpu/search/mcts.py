@@ -61,6 +61,9 @@ class MctsResult:
     depth: int  # principal-variation length
     time_seconds: float
     lines: List[MctsLine] = field(default_factory=list)
+    # Full root visit distribution [(move, visits)], the self-play
+    # training policy target.
+    root_visits: List[Tuple[str, int]] = field(default_factory=list)
 
 
 PENDING_CHILD = -2  # edge has an evaluation in flight
@@ -299,6 +302,7 @@ class _Search:
             depth=len(best.pv),
             time_seconds=elapsed,
             lines=lines,
+            root_visits=[(m, int(n)) for m, n in zip(root.moves, root.n)],
         )
 
 
