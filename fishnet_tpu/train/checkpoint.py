@@ -1,0 +1,51 @@
+"""Training checkpoint/resume via orbax.
+
+The reference's only persistent state is the stats file and ini config
+(SURVEY.md §5: no job checkpointing — batches are minutes-long and
+idempotent by server reassignment). Training runs are hours-long and
+NOT idempotent, so they get real checkpoints: the full train state
+(params, optimizer moments, step) saves atomically and restores
+bit-exactly, sharded arrays included — orbax handles the device
+placement on restore, so a run can resume on a different mesh host
+count as long as the shardings still divide.
+
+Works for both trainer families (TrainState and AzTrainState are plain
+NamedTuple pytrees).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import TypeVar, Union
+
+import jax
+
+StateT = TypeVar("StateT")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: Union[str, Path], state) -> None:
+    """Atomically save a train state (any pytree of arrays)."""
+    path = Path(path).resolve()
+    _checkpointer().save(path, jax.device_get(state), force=True)
+
+
+def restore_checkpoint(path: Union[str, Path], template: StateT) -> StateT:
+    """Restore into the structure of ``template`` (a freshly built state
+    from ``Trainer.init`` / ``AzTrainer.init``), preserving its
+    shardings: restored arrays are placed like the template's."""
+    path = Path(path).resolve()
+    restored = _checkpointer().restore(path, item=jax.device_get(template))
+    placed = jax.tree_util.tree_map(
+        lambda t, r: jax.device_put(r, t.sharding)
+        if hasattr(t, "sharding")
+        else r,
+        template,
+        restored,
+    )
+    return placed

@@ -19,9 +19,8 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from fishnet_tpu.chess.board import Board
+from fishnet_tpu.protocol.types import STARTPOS
 from fishnet_tpu.search.service import SearchService
-
-STARTPOS = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
 
 
 def playout_positions(
@@ -78,6 +77,8 @@ async def label_positions(
     scores = []
     outcomes = []
     for (fen, white_score), board, result in zip(positions, boards, results):
+        # One line per (iteration depth, rank): the LAST multipv-1 entry
+        # is the deepest completed iteration — that's the teacher score.
         line = None
         for l in result.lines:
             if l.multipv == 1:
@@ -93,6 +94,15 @@ async def label_positions(
         scores.append(cp)
         stm_white = board.turn() == "w"
         outcomes.append(white_score if stm_white else 1.0 - white_score)
+    if not indices:
+        # Nothing survived (no positions, or every search failed): an
+        # empty batch is a valid answer the trainer loop can skip.
+        return {
+            "indices": np.zeros((0, 2, 32), np.int32),
+            "buckets": np.zeros((0,), np.int32),
+            "score_cp": np.zeros((0,), np.float32),
+            "outcome": np.zeros((0,), np.float32),
+        }
     return {
         "indices": np.stack(indices).astype(np.int32),
         "buckets": np.asarray(buckets, np.int32),

@@ -17,10 +17,9 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from fishnet_tpu.chess.board import Board
-from fishnet_tpu.models.az_encoding import POLICY_SIZE, board_planes, move_to_index
+from fishnet_tpu.models.az_encoding import INPUT_PLANES, POLICY_SIZE, board_planes, move_to_index
+from fishnet_tpu.protocol.types import STARTPOS
 from fishnet_tpu.search.mcts import MctsPool
-
-STARTPOS = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
 
 
 @dataclass(frozen=True)
@@ -130,6 +129,13 @@ def games_to_batch(games: List[_Game]) -> Dict[str, np.ndarray]:
             planes.append(rec.planes)
             policies.append(rec.policy)
             values.append(z_white if rec.stm_white else -z_white)
+    if not planes:
+        # All games were terminal at the start position: empty batch.
+        return {
+            "planes": np.zeros((0, 8, 8, INPUT_PLANES), np.float32),
+            "policy_target": np.zeros((0, POLICY_SIZE), np.float32),
+            "value_target": np.zeros((0,), np.float32),
+        }
     return {
         "planes": np.stack(planes).astype(np.float32),
         "policy_target": np.stack(policies).astype(np.float32),

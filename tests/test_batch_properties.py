@@ -1,0 +1,122 @@
+"""Property tests for batch assembly / skip handling / reassembly
+(SURVEY.md §4: the reference has zero tests here; these pin the
+invariants its runtime validation silently relies on)."""
+
+import json
+
+from hypothesis import given, settings, strategies as st
+
+from fishnet_tpu.chess.board import Board
+from fishnet_tpu.ipc import Position, PositionResponse
+from fishnet_tpu.protocol.types import AcquireResponseBody, Matrix, Score
+from fishnet_tpu.protocol.types import STARTPOS
+from fishnet_tpu.sched.queue import SKIP, AllSkipped, IncomingBatch, PendingBatch
+
+ENDPOINT = "http://test/fishnet"
+
+
+def random_game(seed: int, plies: int) -> list:
+    """A random legal game line from the start position."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    board = Board(STARTPOS)
+    moves = []
+    for _ in range(plies):
+        legal = board.legal_moves()
+        if not legal or board.outcome() != Board.ONGOING:
+            break
+        mv = legal[int(rng.integers(len(legal)))]
+        board.push_uci(mv)
+        moves.append(mv)
+    return moves
+
+
+def acquired_body(moves, skips):
+    data = {
+        "work": {
+            "type": "analysis",
+            "id": "wkPROP01",
+            "nodes": {"sf15": 1000, "sf14": 1000, "classical": 2000},
+            "timeout": 7000,
+        },
+        "game_id": "propgame",
+        "position": STARTPOS,
+        "variant": "standard",
+        "moves": " ".join(moves),
+        "skipPositions": sorted(skips),
+    }
+    return AcquireResponseBody.from_json(json.loads(json.dumps(data)))
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    seed=st.integers(0, 10_000),
+    plies=st.integers(0, 24),
+    skip_data=st.data(),
+)
+def test_expansion_counts_and_skips(seed, plies, skip_data):
+    moves = random_game(seed, plies)
+    n_positions = len(moves) + 1  # root + one per ply
+    skips = skip_data.draw(
+        st.sets(st.integers(0, n_positions - 1), max_size=n_positions)
+    )
+
+    try:
+        batch = IncomingBatch.from_acquired(ENDPOINT, acquired_body(moves, skips))
+    except AllSkipped:
+        # Only legal when every position was skipped.
+        assert len(skips) == n_positions
+        return
+
+    # Invariant 1: one slot per position, in ply order.
+    assert len(batch.positions) == n_positions
+
+    # Invariant 2: exactly the requested indices are SKIP...
+    got_skips = {i for i, p in enumerate(batch.positions) if p is SKIP}
+    assert got_skips == {s for s in skips if 0 <= s < n_positions}
+
+    # Invariant 3: ...and every non-skip slot is a Position whose move
+    # prefix replays the game up to its ply.
+    for i, p in enumerate(batch.positions):
+        if p is SKIP:
+            continue
+        assert isinstance(p, Position)
+        assert p.position_id == i
+        assert list(p.moves) == moves[:i]
+
+
+@settings(max_examples=20, deadline=None)
+@given(seed=st.integers(0, 10_000), plies=st.integers(1, 16))
+def test_reassembly_order_independent(seed, plies):
+    """Responses arriving in any order reassemble positionally."""
+    import numpy as np
+
+    moves = random_game(seed, plies)
+    batch = IncomingBatch.from_acquired(ENDPOINT, acquired_body(moves, set()))
+    pending = PendingBatch(
+        work=batch.work, flavor=batch.flavor, variant=batch.variant,
+        positions=[None] * len(batch.positions), started_at=0.0, url=batch.url,
+    )
+
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(batch.positions))
+    for i in order:
+        pos = batch.positions[i]
+        scores = Matrix()
+        pvs = Matrix()
+        scores.set(1, 1, Score.cp(100 + int(pos.position_id)))
+        pvs.set(1, 1, ["e2e4"] if pos.root_fen else [])
+        assert pending.try_into_completed() is None
+        pending.positions[pos.position_id] = PositionResponse(
+            work=pos.work, position_id=pos.position_id, scores=scores,
+            pvs=pvs, best_move=None, depth=1, nodes=7, time_seconds=0.01,
+            nps=700, url=pos.url,
+        )
+    completed = pending.try_into_completed()
+    assert completed is not None
+    parts = completed.into_analysis()
+    assert len(parts) == len(batch.positions)
+    # Score i encodes position id i: reassembly preserved ply order.
+    for i, part in enumerate(parts):
+        assert part["score"]["cp"] == 100 + i
