@@ -72,30 +72,30 @@ def _check_key_over_network(endpoint: str, key: str) -> Optional[str]:
         return None
 
 
+def build_search_service(opt: Opt, logger: Logger):
+    """The shared batched-search backend, from CLI options (dev-mode
+    random weights when no --nnue-file is given)."""
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.search.service import SearchService
+
+    kwargs = dict(
+        batch_capacity=opt.resolved_microbatch(),
+        pipeline_depth=opt.pipeline or 1,
+    )
+    if opt.nnue_file:
+        return SearchService(net_path=opt.nnue_file, **kwargs)
+    logger.warn("No --nnue-file given; using random NNUE weights (dev mode).")
+    return SearchService(weights=NnueWeights.random(seed=0), **kwargs)
+
+
 def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
     """Select the backend behind the engine seam (north star: the
     `--engine tpu-nnue` flavor replaces stockfish.rs subprocesses)."""
     engine = opt.resolved_engine()
     if engine == "tpu-nnue":
         from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
-        from fishnet_tpu.nnue.weights import NnueWeights
-        from fishnet_tpu.search.service import SearchService
 
-        depth = opt.pipeline or 1
-        if opt.nnue_file:
-            service = SearchService(
-                net_path=opt.nnue_file,
-                batch_capacity=opt.resolved_microbatch(),
-                pipeline_depth=depth,
-            )
-        else:
-            logger.warn("No --nnue-file given; using random NNUE weights (dev mode).")
-            service = SearchService(
-                weights=NnueWeights.random(seed=0),
-                batch_capacity=opt.resolved_microbatch(),
-                pipeline_depth=depth,
-            )
-        return TpuNnueEngineFactory(service)
+        return TpuNnueEngineFactory(build_search_service(opt, logger))
     if engine == "az-mcts":
         import jax
 
@@ -222,6 +222,17 @@ def main(argv=None) -> int:
         return 0
     if opt.command == "configure":
         return 0  # dialog already ran inside parse_and_configure
+    if opt.command == "uci":
+        from fishnet_tpu.uci_server import serve
+
+        service = build_search_service(opt, logger)
+        try:
+            asyncio.run(serve(service))
+        except KeyboardInterrupt:
+            pass
+        finally:
+            service.close()
+        return 0
 
     if opt.auto_update:
         from fishnet_tpu.update import auto_update

@@ -139,7 +139,7 @@ def parse_toggle(s: str) -> Optional[bool]:
 # Opt
 # ---------------------------------------------------------------------------
 
-COMMANDS = ("run", "configure", "systemd", "systemd-user", "license")
+COMMANDS = ("run", "configure", "systemd", "systemd-user", "uci", "license")
 
 ENGINE_BACKENDS = ("tpu-nnue", "az-mcts", "uci", "mock")
 
@@ -210,7 +210,7 @@ def build_parser() -> argparse.ArgumentParser:
         nargs="?",
         choices=COMMANDS,
         default=None,
-        help="run (default) | configure | systemd | systemd-user | license",
+        help="run (default) | configure | systemd | systemd-user | uci | license",
     )
     p.add_argument("-v", "--verbose", action="count", default=0, help="Increase verbosity.")
     p.add_argument("--auto-update", action="store_true", help="Install updates on startup and periodically.")
@@ -463,7 +463,9 @@ def parse_and_configure(
     if use_conf:
         ini = load_ini(opt.conf_path())
         file_found = opt.conf_path().exists()
-        if (not file_found and opt.command != "run") or opt.command == "configure":
+        # The dialog triggers for bare invocations and `configure` only —
+        # never for `uci`, whose stdin belongs to the GUI's handshake.
+        if (not file_found and opt.command not in ("run", "uci")) or opt.command == "configure":
             if input_fn is None:
                 input_fn = lambda: sys.stdin.readline()
             output.write(INTRO)

@@ -54,6 +54,7 @@ class _Pending:
     future: asyncio.Future
     loop: asyncio.AbstractEventLoop
     started: float
+    token: object = None
 
 
 def _bind_pool_api(lib: ctypes.CDLL) -> None:
@@ -205,6 +206,7 @@ class SearchService:
         self._pending: Dict[int, _Pending] = {}
         self._submissions: List[Tuple] = []
         self._stop_requests: List[Tuple[int, _Pending]] = []
+        self._cancelled_tokens: set = set()
         self._lock = threading.Lock()
         self._warmup_lock = threading.Lock()
         self._warmed = False
@@ -227,15 +229,25 @@ class SearchService:
     ) -> SearchResultData:
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
+        token = object()
         with self._lock:
             if self._stopping:
                 raise NativeCoreError("search service is shut down")
             self._submissions.append(
                 (root_fen, " ".join(moves), nodes, depth, multipv, future, loop,
-                 movetime_seconds, variant)
+                 movetime_seconds, variant, token)
             )
         self._wake.set()
-        return await future
+        try:
+            return await future
+        except asyncio.CancelledError:
+            # Caller gave up (worker time budget / UCI stop): stop the
+            # underlying native search so it frees its pool slot instead
+            # of orphan-draining the shared evaluator.
+            with self._lock:
+                self._cancelled_tokens.add(token)
+            self._wake.set()
+            raise
 
     def warmup(self) -> None:
         """Compile every eval-size bucket with dummy data. Call before
@@ -364,16 +376,23 @@ class SearchService:
             # Apply movetime-watchdog stops (driver thread owns the pool).
             with self._lock:
                 stop_requests, self._stop_requests = self._stop_requests, []
+                cancelled, self._cancelled_tokens = self._cancelled_tokens, set()
             for slot, pending in stop_requests:
                 if self._pending.get(slot) is pending:
                     lib.fc_pool_stop(self._pool, slot)
+            if cancelled:
+                for slot, pending in self._pending.items():
+                    if pending.token in cancelled:
+                        lib.fc_pool_stop(self._pool, slot)
 
             # Drain submissions into pool slots.
             with self._lock:
                 submissions, self._submissions = self._submissions, []
             for item in submissions:
                 (fen, moves, nodes, depth, multipv, future, loop, movetime,
-                 variant) = item
+                 variant, token) = item
+                if token in cancelled:
+                    continue
                 use_scalar = 1 if self.backend == "scalar" else 0
                 slot = lib.fc_pool_submit(
                     self._pool, fen.encode(), moves.encode(),
@@ -392,7 +411,7 @@ class SearchService:
                         NativeCoreError(f"submit failed ({slot})"),
                     )
                     continue
-                pending = _Pending(future, loop, time.monotonic())
+                pending = _Pending(future, loop, time.monotonic(), token)
                 self._pending[slot] = pending
                 if movetime is not None:
                     loop.call_soon_threadsafe(
