@@ -225,6 +225,8 @@ def main(argv=None) -> int:
     if opt.command == "uci":
         from fishnet_tpu.uci_server import serve
 
+        # stdout belongs to the UCI protocol; all logging goes to stderr.
+        logger = Logger(verbose=opt.verbose, stderr=True)
         service = build_search_service(opt, logger)
         try:
             asyncio.run(serve(service))

@@ -1,9 +1,12 @@
-"""Custom TPU kernels (Pallas) with XLA fallbacks.
+"""Custom TPU ops: Pallas kernels and mesh collectives.
 
 * ``ft_gather`` — fused NNUE feature-transformer gather-accumulate,
-  the evaluator's hot op.
+  the evaluator's hot op (Pallas, XLA fallback).
+* ``ring_attention`` — sequence-parallel attention over a mesh axis
+  (shard_map + ppermute ring, flash-style online softmax).
 """
 
 from fishnet_tpu.ops.ft_gather import ft_accumulate
+from fishnet_tpu.ops.ring_attention import reference_attention, ring_attention
 
-__all__ = ["ft_accumulate"]
+__all__ = ["ft_accumulate", "reference_attention", "ring_attention"]

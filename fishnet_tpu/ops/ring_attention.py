@@ -1,0 +1,147 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+The reference has nothing transformer-like — its long-sequence analogue
+is splitting a game into per-ply searches (SURVEY.md §5). This op is the
+real thing for the framework's model side: when a sequence model (e.g. a
+game-history policy net) outgrows one chip's memory, the sequence axis
+shards across devices and attention runs as a ring — each device holds
+its local Q forever, while K/V blocks rotate around the mesh axis via
+``ppermute`` (ICI neighbor exchange, no all-gather), accumulating the
+softmax online flash-attention-style. Peak memory per device is O(S/n)
+with full-attention semantics and compute overlapped with the rotation.
+
+Layout: inputs are [batch, seq_shard, heads, head_dim] per device under
+``shard_map`` (sequence axis sharded over the given mesh axis). The
+causal variant masks by absolute position, handled via the rotating
+block's global offset.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_attention", "reference_attention"]
+
+
+def _block_attend(q, k, v, mask):
+    """One Q-block x K/V-block pass returning (scores_max, exp_sums,
+    weighted_values) for online-softmax accumulation."""
+    # q: [B, Sq, H, D]; k/v: [B, Sk, H, D]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(q.shape[-1])
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    block_max = jnp.max(logits, axis=-1)  # [B, H, Sq]
+    # Guard fully-masked rows: exp(-inf - -inf) would be NaN.
+    safe_max = jnp.where(jnp.isfinite(block_max), block_max, 0.0)
+    p = jnp.exp(logits - safe_max[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    block_sum = jnp.sum(p, axis=-1)  # [B, H, Sq]
+    block_out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return safe_max, block_sum, block_out
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Full-sequence attention with the sequence axis sharded over
+    ``axis``. q, k, v: [batch, seq, heads, head_dim] GLOBAL shapes; the
+    function shard_maps internally and returns the globally-sharded
+    output with the same layout."""
+    n = mesh.shape[axis]
+
+    def local(q_blk, k_blk, v_blk):
+        idx = jax.lax.axis_index(axis)
+        s_local = q_blk.shape[1]
+
+        def make_mask(kv_owner):
+            if not causal:
+                return None
+            q_pos = idx * s_local + jnp.arange(s_local)  # [Sq]
+            k_pos = kv_owner * s_local + jnp.arange(s_local)  # [Sk]
+            return (q_pos[:, None] >= k_pos[None, :])[None, None, :, :]
+
+        def merge(acc, owner, k_cur, v_cur):
+            m_run, l_run, o_run = acc
+            mask = make_mask(owner)
+            b_max, b_sum, b_out = _block_attend(q_blk, k_cur, v_cur, mask)
+            # Online softmax merge (flash-attention recurrence).
+            new_max = jnp.maximum(m_run, b_max)
+            alpha = jnp.exp(m_run - new_max)  # rescale old accumulators
+            beta = jnp.exp(b_max - new_max)
+            l_new = l_run * alpha + b_sum * beta
+            o_new = (
+                o_run * alpha.transpose(0, 2, 1)[..., None]
+                + b_out * beta.transpose(0, 2, 1)[..., None]
+            )
+            return new_max, l_new, o_new
+
+        def step(carry, _):
+            # Rotate first, then attend: the local block was consumed
+            # before the scan, so exactly n-1 rotations happen and none
+            # is discarded.
+            k_cur, v_cur, owner, m_run, l_run, o_run = carry
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            owner_nxt = (owner - 1) % n  # we now hold the previous device's block
+            m_new, l_new, o_new = merge(
+                (m_run, l_run, o_run), owner_nxt, k_nxt, v_nxt
+            )
+            return (k_nxt, v_nxt, owner_nxt, m_new, l_new, o_new), None
+
+        b, s, h, d = q_blk.shape
+        m0 = jnp.full((b, h, s), -jnp.inf, q_blk.dtype)
+        l0 = jnp.zeros((b, h, s), q_blk.dtype)
+        # Newer jax tracks varying-over-mesh-axes types through scan:
+        # constant-initialized carries must be marked varying explicitly.
+        if hasattr(jax.lax, "pvary"):
+            m0 = jax.lax.pvary(m0, (axis,))
+            l0 = jax.lax.pvary(l0, (axis,))
+        # Local block first (no rotation), then n-1 rotate-and-attend hops.
+        m0, l0, o0 = merge((m0, l0, jnp.zeros_like(q_blk)), idx, k_blk, v_blk)
+        (k_f, v_f, _, m_f, l_f, o_f), _ = jax.lax.scan(
+            step, (k_blk, v_blk, idx, m0, l0, o0), None, length=n - 1
+        )
+        del k_f, v_f
+        denom = jnp.maximum(l_f, 1e-20).transpose(0, 2, 1)[..., None]
+        return o_f / denom
+
+    try:
+        from jax import shard_map  # jax >= 0.8 (no check_rep param)
+
+        kwargs = {}
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+        kwargs = {"check_rep": False}
+
+    spec = P(None, axis, None, None)
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        **kwargs,
+    )(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False) -> jax.Array:
+    """Single-device reference for parity tests."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(q.shape[-1])
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

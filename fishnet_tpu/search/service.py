@@ -55,6 +55,7 @@ class _Pending:
     loop: asyncio.AbstractEventLoop
     started: float
     token: object = None
+    stop_event: Optional[threading.Event] = None
 
 
 def _bind_pool_api(lib: ctypes.CDLL) -> None:
@@ -226,7 +227,12 @@ class SearchService:
         multipv: int = 1,
         movetime_seconds: Optional[float] = None,
         variant: Variant = Variant.STANDARD,
+        stop_event: Optional[threading.Event] = None,
     ) -> SearchResultData:
+        """...with ``stop_event``: setting it (then ``poke()``) stops the
+        native search gracefully — the call still returns the partial
+        result (completed iterations), unlike cancellation, which
+        discards the search."""
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         token = object()
@@ -235,7 +241,7 @@ class SearchService:
                 raise NativeCoreError("search service is shut down")
             self._submissions.append(
                 (root_fen, " ".join(moves), nodes, depth, multipv, future, loop,
-                 movetime_seconds, variant, token)
+                 movetime_seconds, variant, token, stop_event)
             )
         self._wake.set()
         try:
@@ -270,6 +276,10 @@ class SearchService:
                 bucks = np.zeros((s,), np.int32)
                 np.asarray(self._eval_fn(self._params, feats, bucks))
             self._warmed = True
+
+    def poke(self) -> None:
+        """Wake the driver (after setting a search's stop_event)."""
+        self._wake.set()
 
     def _maybe_stop(self, slot: int, pending: _Pending) -> None:
         """Movetime watchdog (event-loop thread): hand the stop request to
@@ -380,17 +390,18 @@ class SearchService:
             for slot, pending in stop_requests:
                 if self._pending.get(slot) is pending:
                     lib.fc_pool_stop(self._pool, slot)
-            if cancelled:
-                for slot, pending in self._pending.items():
-                    if pending.token in cancelled:
-                        lib.fc_pool_stop(self._pool, slot)
+            for slot, pending in self._pending.items():
+                if pending.token in cancelled or (
+                    pending.stop_event is not None and pending.stop_event.is_set()
+                ):
+                    lib.fc_pool_stop(self._pool, slot)
 
             # Drain submissions into pool slots.
             with self._lock:
                 submissions, self._submissions = self._submissions, []
             for item in submissions:
                 (fen, moves, nodes, depth, multipv, future, loop, movetime,
-                 variant, token) = item
+                 variant, token, stop_event) = item
                 if token in cancelled:
                     continue
                 use_scalar = 1 if self.backend == "scalar" else 0
@@ -411,7 +422,7 @@ class SearchService:
                         NativeCoreError(f"submit failed ({slot})"),
                     )
                     continue
-                pending = _Pending(future, loop, time.monotonic(), token)
+                pending = _Pending(future, loop, time.monotonic(), token, stop_event)
                 self._pending[slot] = pending
                 if movetime is not None:
                     loop.call_soon_threadsafe(

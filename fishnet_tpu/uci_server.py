@@ -16,27 +16,23 @@ from __future__ import annotations
 
 import asyncio
 import sys
+import threading
 from typing import List, Optional, TextIO
 
-from fishnet_tpu.protocol.types import STARTPOS, Variant
+from fishnet_tpu.protocol.types import STARTPOS, ProtocolError, Variant
 from fishnet_tpu.search.service import SearchResultData, SearchService
 from fishnet_tpu.version import __version__
 
-_VARIANT_BY_UCI = {
-    "chess": Variant.STANDARD,
-    "standard": Variant.STANDARD,
-    "antichess": Variant.ANTICHESS,
-    "giveaway": Variant.ANTICHESS,
-    "atomic": Variant.ATOMIC,
-    "crazyhouse": Variant.CRAZYHOUSE,
-    "horde": Variant.HORDE,
-    "kingofthehill": Variant.KING_OF_THE_HILL,
-    "racingkings": Variant.RACING_KINGS,
-    "3check": Variant.THREE_CHECK,
-    "threecheck": Variant.THREE_CHECK,
-}
-
 INFINITE_GUARD_SECONDS = 3600.0
+
+
+def _parse_uci_variant(value: str) -> Optional[Variant]:
+    if value.lower() == "giveaway":  # Fairy-Stockfish's name for antichess
+        return Variant.ANTICHESS
+    try:
+        return Variant.parse(value)
+    except ProtocolError:
+        return None
 
 
 class UciServer:
@@ -48,6 +44,7 @@ class UciServer:
         self.variant = Variant.STANDARD
         self.multipv = 1
         self._search_task: Optional[asyncio.Task] = None
+        self._stop_event: Optional[threading.Event] = None
 
     def _send(self, line: str) -> None:
         self.out.write(line + "\n")
@@ -83,7 +80,9 @@ class UciServer:
             except ValueError:
                 pass
         elif name == "uci_variant":
-            self.variant = _VARIANT_BY_UCI.get(value.lower(), self.variant)
+            parsed = _parse_uci_variant(value)
+            if parsed is not None:
+                self.variant = parsed
 
     def _cmd_position(self, tokens: List[str]) -> None:
         if not tokens:
@@ -108,7 +107,7 @@ class UciServer:
             result = await self.service.search(
                 self.fen, self.moves, nodes=nodes, depth=depth,
                 multipv=self.multipv, movetime_seconds=movetime,
-                variant=self.variant,
+                variant=self.variant, stop_event=self._stop_event,
             )
         except asyncio.CancelledError:
             raise
@@ -172,6 +171,7 @@ class UciServer:
             movetime = max(0.05, remaining / 40_000.0 + inc * 0.8 / 1000.0)
         if nodes == 0 and depth == 0 and movetime is None:
             depth = 12  # a sane default for bare `go`
+        self._stop_event = threading.Event()
         self._search_task = asyncio.create_task(
             self._run_search(nodes, depth, movetime)
         )
@@ -198,28 +198,15 @@ class UciServer:
         await self._await_search()
 
     async def _cmd_stop(self) -> None:
-        # Cancelling the awaiting coroutine stops the native search (the
-        # service's cancellation path) without emitting a bestmove, so
-        # re-run a tiny search to satisfy UCI's bestmove-after-stop rule.
+        # Graceful stop: the native search halts at its next node poll and
+        # the call returns the PARTIAL result (deepest completed
+        # iterations), which _run_search emits as usual — the GUI gets the
+        # best move the interrupted search actually found.
         if self._search_task is not None and not self._search_task.done():
-            self._search_task.cancel()
-            try:
-                await self._search_task
-            except asyncio.CancelledError:
-                pass
-            self._search_task = None
-            try:
-                result = await self.service.search(
-                    self.fen, self.moves, depth=1, multipv=self.multipv,
-                    variant=self.variant,
-                )
-            except Exception as err:  # noqa: BLE001 - still owe a bestmove
-                self._send(f"info string search failed: {err!r}")
-                self._send("bestmove 0000")
-                return
-            self._emit_result(result)
-        else:
-            await self._await_search()
+            if self._stop_event is not None:
+                self._stop_event.set()
+                self.service.poke()
+        await self._await_search()
 
     # -- main loop ---------------------------------------------------------
 
@@ -256,7 +243,9 @@ class UciServer:
                 break
             if not await self.handle_line(raw.strip()):
                 break
-        await self._await_search()
+        # quit / stdin EOF: a running `go infinite` must not hold the
+        # process open for the guard's full hour.
+        await self._interrupt_search()
 
 
 async def serve(service: SearchService) -> None:
