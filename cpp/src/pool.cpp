@@ -21,6 +21,7 @@
 // same game (adjacent plies across batch positions) share work.
 
 #include <algorithm>
+#include <atomic>
 #include <cstring>
 #include <deque>
 #include <memory>
@@ -69,7 +70,10 @@ struct Slot {
   bool finished = false;   // search complete, result ready
   bool wants_eval = false; // suspended waiting for scores
   bool use_scalar = false; // evaluate immediately with the scalar net
-  bool stop_requested = false;
+  // Written by fc_pool_stop (driver thread) AND fc_pool_stop_all (any
+  // thread, e.g. service close) while the search polls it per node:
+  // atomic, relaxed ordering suffices (it's a latch, not a handoff).
+  std::atomic<bool> stop_requested{false};
   // Eval request state (valid while wants_eval): a block of 1..EVAL_BLOCK_MAX.
   // Features are stored as uint16 (indices < 22528): half the memory per
   // slot and the emission into the device batch is a straight memcpy.
@@ -231,6 +235,15 @@ int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
 void fc_pool_stop(SearchPool* pool, int slot_id) {
   if (slot_id >= 0 && slot_id < int(pool->slots.size()))
     pool->slots[slot_id]->stop_requested = true;
+}
+
+// Stop every active search. Unlike fc_pool_stop (driver-thread only,
+// slot-id addressed), this is safe to call from ANY thread while the
+// driver is blocked inside fc_pool_step: each search polls its
+// stop_requested flag per node, so a long-running scalar search unwinds
+// promptly. Used by service shutdown.
+void fc_pool_stop_all(SearchPool* pool) {
+  for (auto& slot : pool->slots) slot->stop_requested = true;
 }
 
 // Run all runnable fibers until each is blocked on an eval or finished.

@@ -10,6 +10,7 @@
 
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <string>
 #include <vector>
@@ -109,9 +110,10 @@ struct SearchLimits {
   uint64_t nodes = 0;  // 0 = unlimited
   int depth = 0;       // 0 = unlimited (MAX_PLY)
   int multipv = 1;
-  // External stop request (e.g. movetime watchdog); polled per node.
-  // The first depth-1 iteration still completes.
-  const bool* stop = nullptr;
+  // External stop request (e.g. movetime watchdog, service shutdown);
+  // polled per node, may be set from any thread. The first depth-1
+  // iteration still completes.
+  const std::atomic<bool>* stop = nullptr;
 };
 
 struct PvLine {
@@ -160,7 +162,7 @@ class Search {
   // The first depth-1 iteration always completes so every search yields
   // at least one scored line, whatever the node budget.
   bool allow_stop_ = false;
-  const bool* external_stop_ = nullptr;
+  const std::atomic<bool>* external_stop_ = nullptr;
   std::vector<uint64_t> path_;  // hashes from game start through search path
   size_t root_history_len_ = 0;
   Move killers_[MAX_PLY][2];

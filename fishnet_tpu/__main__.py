@@ -95,7 +95,9 @@ def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
     if engine == "tpu-nnue":
         from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
 
-        return TpuNnueEngineFactory(build_search_service(opt, logger))
+        return TpuNnueEngineFactory(
+            service_builder=lambda: build_search_service(opt, logger)
+        )
     if engine == "az-mcts":
         import jax
 
@@ -151,11 +153,12 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         no_stats_file=opt.no_stats_file,
     )
 
+    engine_factory = build_engine_factory(opt, logger)
     client = Client(
         endpoint=opt.resolved_endpoint(),
         key=opt.key,
         cores=opt.resolved_cores(),
-        engine_factory=build_engine_factory(opt, logger),
+        engine_factory=engine_factory,
         logger=logger,
         stats=stats,
         backlog=BacklogOpt(user=opt.user_backlog, system=opt.system_backlog),
@@ -199,6 +202,10 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         for t in (stop_task, drained_task, summary):
             t.cancel()
         await client.stop(abort_pending=stop.is_set())
+        # Tear down shared engine backends before interpreter exit: a
+        # daemon driver thread still inside native/JAX code when Python
+        # unwinds takes the process down with SIGABRT.
+        engine_factory.close()
         logger.fishnet_info(client.stats_summary())
 
 

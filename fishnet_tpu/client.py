@@ -191,12 +191,18 @@ class Client:
                 self._queue_stub.shutdown_soon()
 
         # Workers + queue drain first; the api actor must outlive them to
-        # deliver final submissions/aborts.
+        # deliver final submissions/aborts. On an immediate stop
+        # (abort_pending) in-flight searches are cancelled almost at once
+        # — cancellation propagates to the native search (the reference
+        # SIGKILLs its engine subprocesses here, src/stockfish.rs:138);
+        # a graceful drain gets the full grace period.
         worker_and_queue = [
             t for t in self._tasks if t.get_name() != "api" and not t.done()
         ]
         if worker_and_queue:
-            await asyncio.wait(worker_and_queue, timeout=30.0)
+            await asyncio.wait(
+                worker_and_queue, timeout=2.0 if abort_pending else 30.0
+            )
             for t in worker_and_queue:
                 if not t.done():
                     t.cancel()

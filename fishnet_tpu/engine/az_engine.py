@@ -275,3 +275,8 @@ class AzMctsEngineFactory(EngineFactory):
             return az
         fallback = await self.variant_fallback.create(flavor)
         return _VariantRoutingEngine(az, fallback)
+
+    def close(self) -> None:
+        self.service.close()
+        if self.variant_fallback is not None:
+            self.variant_fallback.close()

@@ -44,3 +44,9 @@ class EngineFactory(abc.ABC):
     @abc.abstractmethod
     async def create(self, flavor: EngineFlavor) -> Engine:
         ...
+
+    def close(self) -> None:
+        """Tear down any shared backend (search service driver threads).
+        Called once at client shutdown; a daemon thread left inside
+        native/JAX code at interpreter exit aborts the process."""
+        return None

@@ -88,8 +88,49 @@ class TpuNnueEngine(Engine):
 
 
 class TpuNnueEngineFactory(EngineFactory):
-    def __init__(self, service: SearchService) -> None:
+    """Hands out engine handles over one shared service; if the service
+    dies (driver crash), the next create() builds a replacement — the
+    worker pool's restart-with-backoff loop (client.py) then recovers
+    exactly like the reference recovers crashed subprocesses
+    (src/main.rs:284-312). Pass ``service_builder`` alone to construct
+    the first service lazily (and off the event loop)."""
+
+    def __init__(self, service: Optional[SearchService] = None,
+                 service_builder=None) -> None:
+        if service is None and service_builder is None:
+            raise ValueError("need a service or a service_builder")
         self.service = service
+        self._builder = service_builder
 
     async def create(self, flavor: EngineFlavor) -> Engine:
+        import asyncio
+
+        if (self.service is None or not self.service.is_alive()) and (
+            self._builder is not None
+        ):
+            old = self.service
+
+            def rebuild():
+                # Construction (pool mmap, weight save, device_put) and the
+                # old driver join can each take seconds: keep them off the
+                # event loop so other workers and the HTTP actor keep
+                # running.
+                svc = self._builder()
+                if old is not None:
+                    try:
+                        old.close()
+                    except Exception:  # noqa: BLE001 - old service broken
+                        pass
+                return svc
+
+            try:
+                self.service = await asyncio.to_thread(rebuild)
+            except Exception as err:  # noqa: BLE001 - keep worker backoff alive
+                raise EngineError(f"engine service rebuild failed: {err!r}") from err
+        if self.service is None or not self.service.is_alive():
+            raise EngineError("engine service is not running")
         return TpuNnueEngine(self.service, flavor)
+
+    def close(self) -> None:
+        if self.service is not None:
+            self.service.close()

@@ -73,6 +73,7 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     ]
     lib.fc_pool_submit.restype = ctypes.c_int
     lib.fc_pool_stop.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.fc_pool_stop_all.argtypes = [ctypes.c_void_p]
     lib.fc_pool_step.argtypes = [
         ctypes.c_void_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
@@ -281,6 +282,16 @@ class SearchService:
         """Wake the driver (after setting a search's stop_event)."""
         self._wake.set()
 
+    def is_alive(self) -> bool:
+        """False once the service is shut down or its driver crashed —
+        callers holding a handle should build a fresh service (the
+        engine-restart analogue of the reference's subprocess respawn,
+        src/main.rs:284-312)."""
+        with self._lock:
+            if self._stopping:
+                return False
+        return self._thread.is_alive()
+
     def _maybe_stop(self, slot: int, pending: _Pending) -> None:
         """Movetime watchdog (event-loop thread): hand the stop request to
         the driver thread, which owns the pool and the slot mapping —
@@ -292,6 +303,12 @@ class SearchService:
     def close(self) -> None:
         with self._lock:
             self._stopping = True
+        # Unblock a driver stuck inside a long native step: every search
+        # polls its stop flag per node, so this unwinds promptly even
+        # mid-scalar-search (safe from any thread: plain bool writes the
+        # search threads poll).
+        if self._pool:
+            self._lib.fc_pool_stop_all(self._pool)
         self._wake.set()
         self._thread.join(timeout=60)
         if self._thread.is_alive():
@@ -428,6 +445,13 @@ class SearchService:
                     loop.call_soon_threadsafe(
                         loop.call_later, movetime, self._maybe_stop, slot, pending
                     )
+
+            # close() may have raced the submission drain above (a fresh
+            # submit re-arms its slot's stop flag): re-check before any
+            # potentially long native step; the loop top fails everything.
+            with self._lock:
+                if self._stopping:
+                    continue
 
             stepped = 0
             for g in range(k):

@@ -1,0 +1,108 @@
+"""Failure-detection / recovery paths (SURVEY.md §5): a dead search
+service is detected and replaced, in-flight work fails cleanly, and the
+client keeps serving after the restart."""
+
+import asyncio
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+from fake_server import FakeServer  # noqa: E402
+from test_client_e2e import make_client, wait_for  # noqa: E402
+
+from fishnet_tpu.chess.core import NativeCoreError
+from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
+from fishnet_tpu.nnue.weights import NnueWeights
+from fishnet_tpu.protocol.types import EngineFlavor
+from fishnet_tpu.search.service import SearchService
+
+pytestmark = pytest.mark.anyio
+
+
+def make_service():
+    return SearchService(
+        weights=NnueWeights.random(seed=0), pool_slots=16,
+        batch_capacity=64, tt_bytes=8 << 20, backend="scalar",
+    )
+
+
+async def test_close_unwinds_inflight_searches_promptly():
+    # A 50M-node scalar search would run for minutes; close() must unwind
+    # it promptly (stop-all), resolving the caller with either a partial
+    # result (search stopped in time) or a shutdown error — never a hang.
+    service = make_service()
+    task = asyncio.create_task(
+        service.search("rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+                       [], nodes=50_000_000)
+    )
+    await asyncio.sleep(0.3)
+    service.close()
+    try:
+        result = await asyncio.wait_for(task, 30)
+        assert result.nodes < 50_000_000  # stopped early, partial result
+    except NativeCoreError:
+        pass  # shutdown beat the harvest: equally acceptable
+    assert not service.is_alive()
+
+
+async def test_factory_replaces_dead_service():
+    service = make_service()
+    rebuilt = []
+
+    def builder():
+        svc = make_service()
+        rebuilt.append(svc)
+        return svc
+
+    factory = TpuNnueEngineFactory(service, service_builder=builder)
+    service.close()
+    engine = await factory.create(EngineFlavor.OFFICIAL)
+    assert rebuilt and factory.service is rebuilt[0]
+    assert factory.service.is_alive()
+    res = await engine.service.search(
+        "6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1", [], depth=3
+    )
+    assert res.best_move == "d1d8"
+    for svc in rebuilt:
+        svc.close()
+
+
+async def test_client_recovers_from_service_death():
+    service = make_service()
+    services = [service]
+
+    def builder():
+        svc = make_service()
+        services.append(svc)
+        return svc
+
+    async with FakeServer() as server:
+        first = server.lichess.add_analysis_job(moves="e2e4", nodes=2000)
+        client = make_client(
+            server.endpoint, cores=1,
+            engine_factory=TpuNnueEngineFactory(service, service_builder=builder),
+        )
+        await client.start()
+        assert await wait_for(lambda: first in server.lichess.analyses)
+
+        # Kill the shared service under the running client. The next job
+        # fails and its batch is abandoned (reference semantics: the
+        # server's timeout would reassign it) — then the worker restarts
+        # its engine via the factory and the REPLACEMENT service serves
+        # subsequent work.
+        service.close()
+        sacrificial = server.lichess.add_analysis_job(moves="d2d4", nodes=2000)
+        for _ in range(100):
+            if rebuilt := services[1:]:
+                break
+            await asyncio.sleep(0.2)
+        recovered = server.lichess.add_analysis_job(moves="g1f3", nodes=2000)
+        assert await wait_for(
+            lambda: recovered in server.lichess.analyses, timeout=60
+        )
+        assert sacrificial not in server.lichess.analyses  # abandoned, not lied about
+        await client.stop()
+    for svc in services:
+        svc.close()
