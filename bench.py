@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import json
 import sys
+import threading
 import time
 
 REFERENCE_BASELINE_NPS = 60 * 2_000_000 / 35.0  # top-end fishnet client
@@ -34,6 +35,12 @@ REFERENCE_BASELINE_NPS = 60 * 2_000_000 / 35.0  # top-end fishnet client
 CONCURRENT_BATCHES = 64
 POSITIONS_PER_BATCH = 60
 NODES_PER_SEARCH = 4_000
+#: Measurement window. Tunnel round-trip latency varies several-fold run
+#: to run; a fixed window keeps bench wall-clock bounded (deadline-style
+#: runs would otherwise take 6-20 min) while measuring the same
+#: steady-state aggregate rate: searches stopped at the deadline report
+#: the nodes they actually completed.
+BENCH_SECONDS = 240.0
 
 
 def log(msg: str) -> None:
@@ -53,12 +60,24 @@ FENS = [
 ]
 
 
-async def run_searches(service, n: int, nodes: int) -> int:
+async def run_searches(service, n: int, nodes: int,
+                       deadline_seconds: float = 0.0) -> int:
+    stop_event = threading.Event() if deadline_seconds else None
     tasks = [
-        service.search(root_fen=FENS[i % len(FENS)], moves=[], nodes=nodes, depth=0, multipv=1)
+        service.search(root_fen=FENS[i % len(FENS)], moves=[], nodes=nodes,
+                       depth=0, multipv=1, stop_event=stop_event)
         for i in range(n)
     ]
+    watchdog = None
+    if stop_event is not None:
+        async def fire():
+            await asyncio.sleep(deadline_seconds)
+            stop_event.set()
+            service.poke()
+        watchdog = asyncio.create_task(fire())
     results = await asyncio.gather(*tasks)
+    if watchdog is not None:
+        watchdog.cancel()
     return sum(r.nodes for r in results)
 
 
@@ -89,7 +108,10 @@ def main() -> None:
             f"x {NODES_PER_SEARCH} nodes..."
         )
         start = time.perf_counter()
-        total_nodes = asyncio.run(run_searches(service, n_searches, NODES_PER_SEARCH))
+        total_nodes = asyncio.run(
+            run_searches(service, n_searches, NODES_PER_SEARCH,
+                         deadline_seconds=BENCH_SECONDS)
+        )
         elapsed = time.perf_counter() - start
     finally:
         service.close()
