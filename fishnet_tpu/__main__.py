@@ -74,18 +74,37 @@ def _check_key_over_network(endpoint: str, key: str) -> Optional[str]:
 
 def build_search_service(opt: Opt, logger: Logger):
     """The shared batched-search backend, from CLI options (dev-mode
-    random weights when no --nnue-file is given)."""
+    random weights when no --nnue-file is given). Without --pipeline the
+    depth is probed: overlapping transports (locally attached TPUs) get
+    a multi-batch pipeline, serialized tunnels stay at depth 1."""
     from fishnet_tpu.nnue.weights import NnueWeights
-    from fishnet_tpu.search.service import SearchService
+    from fishnet_tpu.search.service import SearchService, suggest_pipeline_depth
 
-    kwargs = dict(
-        batch_capacity=opt.resolved_microbatch(),
-        pipeline_depth=opt.pipeline or 1,
-    )
     if opt.nnue_file:
-        return SearchService(net_path=opt.nnue_file, **kwargs)
-    logger.warn("No --nnue-file given; using random NNUE weights (dev mode).")
-    return SearchService(weights=NnueWeights.random(seed=0), **kwargs)
+        weights = NnueWeights.load(opt.nnue_file)
+    else:
+        logger.warn("No --nnue-file given; using random NNUE weights (dev mode).")
+        weights = NnueWeights.random(seed=0)
+
+    depth = opt.pipeline
+    if depth is None:
+        try:
+            # Probe at the production microbatch size: overlap ratios are
+            # shape-dependent (dispatch overhead vs compute time).
+            depth = suggest_pipeline_depth(
+                weights, size=max(64, min(opt.resolved_microbatch(), 4096))
+            )
+        except Exception as err:  # noqa: BLE001 - probe is best-effort
+            logger.debug(f"Pipeline probe failed ({err!r}); using depth 1.")
+            depth = 1
+        if depth > 1:
+            logger.info(f"Device dispatch overlaps; pipelining {depth} eval batches.")
+    return SearchService(
+        weights=weights,
+        net_path=opt.nnue_file,  # native pool reads the original file
+        batch_capacity=opt.resolved_microbatch(),
+        pipeline_depth=depth,
+    )
 
 
 def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:

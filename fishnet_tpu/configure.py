@@ -233,8 +233,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--az-net-file", default=None,
                    help="Policy+value net checkpoint (.npz) for --engine az-mcts.")
     p.add_argument("--pipeline", type=int, default=None,
-                   help="Eval pipeline depth (in-flight device batches). Default 1; "
-                        "raise to 2-4 on locally attached TPUs.")
+                   help="Eval pipeline depth (in-flight device batches). Default: "
+                        "probe the device at startup (serialized tunnels get 1, "
+                        "locally attached TPUs 2-4).")
     return p
 
 

@@ -105,6 +105,50 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib._pool_bound = True
 
 
+def suggest_pipeline_depth(weights: "NnueWeights", size: int = 1024,
+                           rounds: int = 4, device_params=None) -> int:
+    """Probe whether concurrent device dispatches overlap, and suggest a
+    pipeline depth for SearchService.
+
+    On latency-dominated serialized transports (remote/tunneled devices)
+    k batches cost ~k round trips, so depth 1 wins; on locally attached
+    TPUs dispatch is asynchronous and 2-4 batches overlap host, PCIe and
+    device time. The probe times `rounds` evals run back-to-back
+    (blocking each) against the same evals dispatched together, and
+    returns 4/2/1 as the overlap ratio falls."""
+    import time
+
+    import jax
+
+    from fishnet_tpu.nnue import spec
+    from fishnet_tpu.nnue.jax_eval import evaluate_batch_jit, params_from_weights
+
+    params = device_params
+    if params is None:
+        params = jax.device_put(params_from_weights(weights))
+    feats = np.full((size, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.uint16)
+    buckets = np.zeros((size,), np.int32)
+    np.asarray(evaluate_batch_jit(params, feats, buckets))  # compile + warm
+
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        np.asarray(evaluate_batch_jit(params, feats, buckets))
+    sequential = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    arrs = [evaluate_batch_jit(params, feats, buckets) for _ in range(rounds)]
+    for a in arrs:
+        np.asarray(a)
+    pipelined = time.perf_counter() - t0
+
+    ratio = sequential / max(pipelined, 1e-9)
+    if ratio >= 2.5:
+        return 4
+    if ratio >= 1.6:
+        return 2
+    return 1
+
+
 #: Must cover the native core's largest single eval block
 #: (cpp/src/search.h:32 EVAL_BLOCK_MAX): emit_block is all-or-nothing, so
 #: a capacity below one block would never fit it and the fiber would wait
