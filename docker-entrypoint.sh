@@ -16,5 +16,6 @@ if [ -n "$ENGINE_EXE" ]; then args+=("--engine-exe" "$ENGINE_EXE"); fi
 if [ -n "$NNUE_FILE" ]; then args+=("--nnue-file" "$NNUE_FILE"); fi
 if [ -n "$AZ_NET_FILE" ]; then args+=("--az-net-file" "$AZ_NET_FILE"); fi
 if [ -n "$MICROBATCH" ]; then args+=("--microbatch" "$MICROBATCH"); fi
+if [ -n "$PIPELINE" ]; then args+=("--pipeline" "$PIPELINE"); fi
 
 exec python -m fishnet_tpu "${args[@]}"

@@ -74,6 +74,10 @@ def _exec_start(opt: Opt, *, absolute: bool) -> str:
         args += ["--nnue-file", shlex.quote(path(opt.nnue_file))]
     if opt.microbatch is not None:
         args += ["--microbatch", str(opt.microbatch)]
+    if opt.az_net_file is not None:
+        args += ["--az-net-file", shlex.quote(path(opt.az_net_file))]
+    if opt.pipeline is not None:
+        args += ["--pipeline", str(opt.pipeline)]
 
     return " ".join(args)
 
