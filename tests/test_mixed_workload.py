@@ -1,0 +1,81 @@
+"""Production-shaped soak: standard analysis, Chess960, a variant batch,
+MultiPV, and best-move play jobs all flowing through one shared batched
+engine concurrently — the closest in-repo approximation of the workload
+mix a live client serves."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+from fake_server import FakeServer  # noqa: E402
+from test_client_e2e import make_client, wait_for  # noqa: E402
+
+from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
+from fishnet_tpu.nnue.weights import NnueWeights
+from fishnet_tpu.search.service import SearchService
+
+pytestmark = pytest.mark.anyio
+
+FRC_START = "bqnrkbnr/pppppppp/8/8/8/8/PPPPPPPP/BQNRKBNR w DHdh - 0 1"
+
+
+async def test_mixed_workload_soak():
+    service = SearchService(
+        weights=NnueWeights.random(seed=0), pool_slots=64,
+        batch_capacity=128, tt_bytes=16 << 20, backend="scalar",
+    )
+    try:
+        async with FakeServer() as server:
+            jobs = {
+                "standard": server.lichess.add_analysis_job(
+                    moves="e2e4 c7c5 g1f3", nodes=2000
+                ),
+                "frc": server.lichess.add_analysis_job(
+                    moves="d2d4", position=FRC_START, variant="chess960",
+                    nodes=2000,
+                ),
+                "multipv": server.lichess.add_analysis_job(
+                    moves="e2e4", nodes=2000, multipv=3
+                ),
+                "atomic": server.lichess.add_analysis_job(
+                    moves="e2e4 d7d5", variant="atomic", nodes=2000
+                ),
+                "crazyhouse": server.lichess.add_analysis_job(
+                    moves="e2e4 e7e5", variant="crazyhouse", nodes=2000
+                ),
+                "play": server.lichess.add_move_job(
+                    moves="e2e4 e7e5", level=3
+                ),
+            }
+            client = make_client(
+                server.endpoint, cores=4,
+                engine_factory=TpuNnueEngineFactory(service),
+            )
+            await client.start()
+            assert await wait_for(
+                lambda: all(
+                    (j in server.lichess.analyses) or (j in server.lichess.moves)
+                    for j in jobs.values()
+                ),
+                timeout=120,
+            ), {
+                name: (j in server.lichess.analyses, j in server.lichess.moves)
+                for name, j in jobs.items()
+            }
+            await client.stop()
+
+            assert server.lichess.analyses[jobs["standard"]]["stockfish"]["flavor"] == "nnue"
+            assert server.lichess.analyses[jobs["atomic"]]["stockfish"]["flavor"] == "classical"
+            # MultiPV analysis: matrix rows for 3 ranks on the final ply.
+            parts = server.lichess.analyses[jobs["multipv"]]["analysis"]
+            assert any(
+                isinstance(p.get("pv"), list) and len(p["pv"]) >= 2
+                for p in parts if p and not p.get("skipped")
+            )
+            # Play job answered with a legal-looking move.
+            best = server.lichess.moves[jobs["play"]]["move"]["bestmove"]
+            assert best and len(best) >= 4
+    finally:
+        service.close()
