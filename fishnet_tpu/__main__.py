@@ -121,17 +121,29 @@ def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
         import jax
 
         from fishnet_tpu.engine.az_engine import AzMctsEngineFactory, AzMctsService
-        from fishnet_tpu.models.az import init_az_params
+        from fishnet_tpu.models.az import az_config_from_params, init_az_params
         from fishnet_tpu.search.mcts import MctsConfig
 
-        cfg = MctsConfig(batch_capacity=opt.resolved_microbatch())
         if opt.az_net_file:
+            import zipfile
+
             import numpy as np
 
-            loaded = np.load(opt.az_net_file)
-            params = {k: loaded[k] for k in loaded.files}
+            # Checkpoints carry no explicit architecture metadata; every
+            # AzConfig field is recoverable from parameter shapes, and a
+            # missing/corrupt/non-AZ file fails here with a clear message
+            # instead of a traceback or a shape error inside the jitted
+            # forward at warmup.
+            try:
+                loaded = np.load(opt.az_net_file)
+                params = {k: loaded[k] for k in loaded.files}
+                az_cfg = az_config_from_params(params)
+            except (OSError, ValueError, zipfile.BadZipFile) as err:
+                raise ConfigError(f"--az-net-file {opt.az_net_file}: {err}") from err
+            cfg = MctsConfig(batch_capacity=opt.resolved_microbatch(), az=az_cfg)
         else:
             logger.warn("No --az-net-file given; using random policy+value net (dev mode).")
+            cfg = MctsConfig(batch_capacity=opt.resolved_microbatch())
             params = init_az_params(jax.random.PRNGKey(0), cfg.az)
         # Variant work can't ride the AZ policy encoding; route it to the
         # native HCE alpha-beta tier (scalar backend: no device traffic).
@@ -271,6 +283,11 @@ def main(argv=None) -> int:
         asyncio.run(run_client(opt, logger))
     except KeyboardInterrupt:
         pass
+    except ConfigError as err:
+        # Late config errors (e.g. a bad --az-net-file discovered while
+        # building the engine factory) exit cleanly, not as a traceback.
+        sys.stderr.write(f"E: {err}\n")
+        return 2
     return 0
 
 

@@ -12,6 +12,7 @@ movetime/depth by skill level.
 
 from __future__ import annotations
 
+import asyncio
 from typing import Optional
 
 from fishnet_tpu.engine.base import Engine, EngineFactory, EngineError
@@ -101,32 +102,40 @@ class TpuNnueEngineFactory(EngineFactory):
             raise ValueError("need a service or a service_builder")
         self.service = service
         self._builder = service_builder
+        self._rebuild_lock = asyncio.Lock()
 
     async def create(self, flavor: EngineFlavor) -> Engine:
-        import asyncio
-
         if (self.service is None or not self.service.is_alive()) and (
             self._builder is not None
         ):
-            old = self.service
+            # After a service death every restarting worker lands here at
+            # once; without mutual exclusion each would build (and all but
+            # one leak) a full service — driver thread, pool mmap,
+            # device-resident params. One worker rebuilds, the rest wait
+            # and re-check.
+            async with self._rebuild_lock:
+                if self.service is None or not self.service.is_alive():
+                    old = self.service
 
-            def rebuild():
-                # Construction (pool mmap, weight save, device_put) and the
-                # old driver join can each take seconds: keep them off the
-                # event loop so other workers and the HTTP actor keep
-                # running.
-                svc = self._builder()
-                if old is not None:
+                    def rebuild():
+                        # Construction (pool mmap, weight save, device_put)
+                        # and the old driver join can each take seconds:
+                        # keep them off the event loop so other workers and
+                        # the HTTP actor keep running.
+                        svc = self._builder()
+                        if old is not None:
+                            try:
+                                old.close()
+                            except Exception:  # noqa: BLE001 - old service broken
+                                pass
+                        return svc
+
                     try:
-                        old.close()
-                    except Exception:  # noqa: BLE001 - old service broken
-                        pass
-                return svc
-
-            try:
-                self.service = await asyncio.to_thread(rebuild)
-            except Exception as err:  # noqa: BLE001 - keep worker backoff alive
-                raise EngineError(f"engine service rebuild failed: {err!r}") from err
+                        self.service = await asyncio.to_thread(rebuild)
+                    except Exception as err:  # noqa: BLE001 - keep worker backoff alive
+                        raise EngineError(
+                            f"engine service rebuild failed: {err!r}"
+                        ) from err
         if self.service is None or not self.service.is_alive():
             raise EngineError("engine service is not running")
         return TpuNnueEngine(self.service, flavor)

@@ -115,3 +115,42 @@ def value_to_centipawns(v: float) -> int:
     protocol (the same tan mapping family Lc0 uses for UCI output)."""
     v = float(np.clip(v, -0.9999, 0.9999))
     return int(round(111.7 * np.tan(1.5620688421 * v)))
+
+
+def az_config_from_params(params: Params) -> AzConfig:
+    """Recover the architecture a checkpoint was trained with.
+
+    Every AzConfig field is determined by parameter shapes, so `.npz`
+    checkpoints need no architecture metadata; loading a net trained with
+    a non-default config (--az-net-file) reconstructs the right config
+    instead of crashing shape-mismatched inside the jitted forward.
+    """
+    required = ("stem_b", "policy_b", "value_fc1_b")
+    missing = [k for k in required if k not in params]
+    if missing:
+        raise ValueError(
+            f"not an AZ checkpoint: missing parameter(s) {missing}; "
+            f"got keys {sorted(params)[:8]}..."
+        )
+    blocks = 0
+    while f"res{blocks}_w1" in params:
+        blocks += 1
+    cfg = AzConfig(
+        channels=int(np.shape(params["stem_b"])[0]),
+        blocks=blocks,
+        value_hidden=int(np.shape(params["value_fc1_b"])[0]),
+        policy_planes=int(np.shape(params["policy_b"])[0]),
+    )
+    # eval_shape: shape-only abstract trace, no device traffic — this runs
+    # at client startup where the default backend may be a tunneled TPU.
+    shapes = jax.eval_shape(lambda: init_az_params(jax.random.PRNGKey(0), cfg))
+    expected = {k: v.shape for k, v in shapes.items()}
+    got = {k: tuple(np.shape(v)) for k, v in params.items()}
+    if {k: tuple(v) for k, v in expected.items()} != got:
+        diff = {k for k in set(expected) ^ set(got)} or {
+            k for k in expected if tuple(expected[k]) != got.get(k)
+        }
+        raise ValueError(
+            f"AZ checkpoint does not match any {cfg}: mismatched keys {sorted(diff)}"
+        )
+    return cfg

@@ -349,8 +349,8 @@ class SearchService:
             self._stopping = True
         # Unblock a driver stuck inside a long native step: every search
         # polls its stop flag per node, so this unwinds promptly even
-        # mid-scalar-search (safe from any thread: plain bool writes the
-        # search threads poll).
+        # mid-scalar-search (safe from any thread: the per-slot stop flags
+        # are std::atomic<bool> latches).
         if self._pool:
             self._lib.fc_pool_stop_all(self._pool)
         self._wake.set()

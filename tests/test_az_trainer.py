@@ -83,3 +83,34 @@ def test_az_export_roundtrip_into_engine(tmp_path):
         if pool.active() == 0:
             break
     assert pool.harvest(sid).best_move == "d1d8"
+
+
+def test_az_config_recovered_from_checkpoint_shapes(tmp_path):
+    """--az-net-file must work for nets trained with any AzConfig: the
+    architecture is inferred from parameter shapes (models/az.py), not
+    assumed to be the default."""
+    from fishnet_tpu.models.az import az_config_from_params
+
+    cfg = AzConfig(channels=24, blocks=3, value_hidden=20)
+    trainer = AzTrainer(cfg=cfg)
+    state = trainer.init(seed=3)
+    path = tmp_path / "az24.npz"
+    trainer.export(state, str(path))
+
+    loaded = np.load(path)
+    params = {k: loaded[k] for k in loaded.files}
+    assert az_config_from_params(params) == cfg
+
+
+def test_az_config_rejects_non_az_checkpoint():
+    from fishnet_tpu.models.az import az_config_from_params
+
+    with pytest.raises(ValueError, match="not an AZ checkpoint"):
+        az_config_from_params({"w": np.zeros((3, 3))})
+
+    # Right keys, tampered shape: still a clear error.
+    trainer = AzTrainer(cfg=TINY)
+    params = {k: np.asarray(v) for k, v in trainer.init(seed=0).params.items()}
+    params["value_fc1_w"] = params["value_fc1_w"][:, :-1]
+    with pytest.raises(ValueError, match="does not match"):
+        az_config_from_params(params)

@@ -106,3 +106,24 @@ async def test_client_recovers_from_service_death():
         await client.stop()
     for svc in services:
         svc.close()
+
+
+async def test_concurrent_creates_rebuild_exactly_once():
+    # After a service death, N workers restart at once; the factory must
+    # serialize the rebuild so N-1 services are not built and leaked.
+    service = make_service()
+    rebuilt = []
+
+    def builder():
+        svc = make_service()
+        rebuilt.append(svc)
+        return svc
+
+    factory = TpuNnueEngineFactory(service, service_builder=builder)
+    service.close()
+    engines = await asyncio.gather(
+        *(factory.create(EngineFlavor.OFFICIAL) for _ in range(6))
+    )
+    assert len(rebuilt) == 1
+    assert all(e.service is rebuilt[0] for e in engines)
+    rebuilt[0].close()
