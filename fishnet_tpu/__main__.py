@@ -72,11 +72,63 @@ def _check_key_over_network(endpoint: str, key: str) -> Optional[str]:
         return None
 
 
+def validate_mesh(opt: Opt) -> None:
+    """Fail an explicit --mesh DxM that exceeds the visible devices NOW,
+    with a clean ConfigError — the service itself is built lazily (inside
+    the engine factory's rebuild path), where a config mistake would
+    otherwise surface as an endless worker-restart backoff loop."""
+    mesh_spec = opt.resolved_mesh()
+    if mesh_spec in ("auto", "off"):
+        return
+    import jax
+
+    data, model = (int(x) for x in mesh_spec.split("x"))
+    n = len(jax.devices())
+    if data * model > n:
+        raise ConfigError(f"--mesh {mesh_spec} needs {data * model} devices, found {n}")
+
+
+def build_sharded_evaluator(opt: Opt, weights, logger: Logger):
+    """The multi-chip serving tier: a ShardedEvaluator that splits every
+    eval microbatch over a device mesh (pure dp; params replicated).
+    Returns None when single-device serving is the right call — one
+    visible device, --mesh off, or a mesh that doesn't match the
+    hardware."""
+    mesh_spec = opt.resolved_mesh()
+    if mesh_spec == "off":
+        return None
+    import jax
+
+    n = len(jax.devices())
+    if n < 2 and mesh_spec == "auto":
+        return None
+    from fishnet_tpu.nnue.jax_eval import params_from_weights
+    from fishnet_tpu.parallel.mesh import ShardedEvaluator, make_mesh
+
+    validate_mesh(opt)
+    if mesh_spec == "auto":
+        mesh = make_mesh()
+    else:
+        data, model = (int(x) for x in mesh_spec.split("x"))
+        mesh = make_mesh(jax.devices()[: data * model], data=data, model=model)
+    logger.info(
+        f"Sharding eval batches over a {mesh.devices.shape[0]}x"
+        f"{mesh.devices.shape[1]} device mesh."
+    )
+    return ShardedEvaluator(
+        params_from_weights(weights),
+        mesh=mesh,
+        batch_capacity=opt.resolved_microbatch(),
+    )
+
+
 def build_search_service(opt: Opt, logger: Logger):
     """The shared batched-search backend, from CLI options (dev-mode
     random weights when no --nnue-file is given). Without --pipeline the
     depth is probed: overlapping transports (locally attached TPUs) get
-    a multi-batch pipeline, serialized tunnels stay at depth 1."""
+    a multi-batch pipeline, serialized tunnels stay at depth 1. With >1
+    visible device (or an explicit --mesh) eval batches are sharded over
+    a device mesh instead of riding one chip."""
     from fishnet_tpu.nnue.weights import NnueWeights
     from fishnet_tpu.search.service import SearchService, suggest_pipeline_depth
 
@@ -86,13 +138,20 @@ def build_search_service(opt: Opt, logger: Logger):
         logger.warn("No --nnue-file given; using random NNUE weights (dev mode).")
         weights = NnueWeights.random(seed=0)
 
+    evaluator = build_sharded_evaluator(opt, weights, logger)
+
     depth = opt.pipeline
     if depth is None:
         try:
             # Probe at the production microbatch size: overlap ratios are
-            # shape-dependent (dispatch overhead vs compute time).
+            # shape-dependent (dispatch overhead vs compute time). When a
+            # sharded evaluator is installed, probe THAT — the
+            # single-device jit's overlap says nothing about the sharded
+            # computation serving will actually run.
             depth = suggest_pipeline_depth(
-                weights, size=max(64, min(opt.resolved_microbatch(), 4096))
+                weights,
+                size=max(64, min(opt.resolved_microbatch(), 4096)),
+                eval_fn=evaluator,
             )
         except Exception as err:  # noqa: BLE001 - probe is best-effort
             logger.debug(f"Pipeline probe failed ({err!r}); using depth 1.")
@@ -104,6 +163,7 @@ def build_search_service(opt: Opt, logger: Logger):
         net_path=opt.nnue_file,  # native pool reads the original file
         batch_capacity=opt.resolved_microbatch(),
         pipeline_depth=depth,
+        evaluator=evaluator,
     )
 
 
@@ -114,6 +174,7 @@ def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
     if engine == "tpu-nnue":
         from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
 
+        validate_mesh(opt)  # fail fast; the service builds lazily
         return TpuNnueEngineFactory(
             service_builder=lambda: build_search_service(opt, logger)
         )
@@ -265,7 +326,11 @@ def main(argv=None) -> int:
 
         # stdout belongs to the UCI protocol; all logging goes to stderr.
         logger = Logger(verbose=opt.verbose, stderr=True)
-        service = build_search_service(opt, logger)
+        try:
+            service = build_search_service(opt, logger)
+        except ConfigError as err:
+            sys.stderr.write(f"E: {err}\n")
+            return 2
         try:
             asyncio.run(serve(service))
         except KeyboardInterrupt:

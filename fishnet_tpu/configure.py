@@ -19,6 +19,7 @@ import argparse
 import configparser
 import io
 import os
+import re
 import sys
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -121,6 +122,17 @@ def parse_backlog(s: str) -> float:
     return parse_duration(s)
 
 
+def parse_mesh(s: str) -> str:
+    """``auto`` | ``off`` | explicit ``DATAxMODEL`` (e.g. ``4x2``)."""
+    t = s.strip().lower()
+    if t in ("auto", "off"):
+        return t
+    m = re.fullmatch(r"(\d+)x(\d+)", t)
+    if m and int(m.group(1)) >= 1 and int(m.group(2)) >= 1:
+        return t
+    raise ConfigError(f"invalid mesh spec: {s!r} (use auto, off, or DATAxMODEL)")
+
+
 def parse_toggle(s: str) -> Optional[bool]:
     """Lenient y/n parsing for dialog answers (configure.rs:352-363).
     Returns None for the empty string (take the default); raises on
@@ -173,6 +185,10 @@ class Opt:
     az_net_file: Optional[str] = None
     microbatch: Optional[int] = None
     pipeline: Optional[int] = None
+    #: Device-mesh policy for the serving evaluator: "auto" (shard the
+    #: eval batch whenever >1 device is visible), "off" (single device),
+    #: or an explicit "DATAxMODEL" shape such as "4x2".
+    mesh: Optional[str] = None
 
     def conf_path(self) -> Path:
         return Path(self.conf) if self.conf else Path("fishnet.ini")
@@ -191,6 +207,9 @@ class Opt:
 
     def resolved_microbatch(self) -> int:
         return self.microbatch if self.microbatch is not None else 1024
+
+    def resolved_mesh(self) -> str:
+        return self.mesh or "auto"
 
     def resolved_command(self) -> str:
         return self.command or "run"
@@ -236,6 +255,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Eval pipeline depth (in-flight device batches). Default: "
                         "probe the device at startup (serialized tunnels get 1, "
                         "locally attached TPUs 2-4).")
+    p.add_argument("--mesh", default=None,
+                   help="Device mesh for the serving evaluator: auto (default; "
+                        "shard eval batches over all visible devices), off "
+                        "(single device), or DATAxMODEL (e.g. 4x2).")
     return p
 
 
@@ -273,6 +296,8 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
         if ns.pipeline < 1:
             raise ConfigError("--pipeline must be >= 1")
         opt.pipeline = ns.pipeline
+    if ns.mesh is not None:
+        opt.mesh = parse_mesh(ns.mesh)
     return opt
 
 
@@ -292,6 +317,7 @@ _INI_FIELDS = (
     ("EngineExe", "engine_exe", str),
     ("NnueFile", "nnue_file", str),
     ("AzNetFile", "az_net_file", str),
+    ("Mesh", "mesh", parse_mesh),
 )
 
 

@@ -11,8 +11,11 @@ Axes:
 
 * ``data``  — batch dimension of eval/training microbatches (dp).
 * ``model`` — the feature-transformer width L1 and the contracting
-  dimension of the first dense layer (tp). The FT table is the only
-  big tensor (22528 x 1024 int16), so this is where sharding pays.
+  dimension of the first dense layer (tp). Only the *trainer* shards
+  over it (the FT table is the one big tensor, 22528 x 1024, and its
+  optimizer state triples the footprint); serving replicates params
+  and uses the model axis as extra batch parallelism — see
+  ``ShardedEvaluator``.
 
 All collectives are inserted by XLA/GSPMD from sharding annotations —
 there are no hand-written collectives anywhere in the framework.
@@ -82,11 +85,12 @@ def pad_to_multiple(n: int, multiple: int) -> int:
 class ShardedEvaluator:
     """Batched NNUE evaluation sharded across a mesh.
 
-    Params are replicated (the whole net is ~47 MiB — tiny next to HBM)
-    and the microbatch is split over every device; XLA turns the final
-    gather of per-position scores into an all-gather over ICI. This is
-    the multi-chip version of ``evaluate_batch_jit`` and plugs into
-    ``SearchService`` via the ``eval_fn`` seam.
+    Serving shards the *batch* over every device on both mesh axes (pure
+    dp — for a ~47 MiB net, replicating params and splitting positions is
+    strictly better than splitting the FT width; tp over the model axis
+    is used by the trainer, not here). XLA turns the final gather of
+    per-position scores into an all-gather over ICI. Drop-in for
+    ``evaluate_batch_jit`` behind ``SearchService``'s ``evaluator`` seam.
     """
 
     def __init__(self, params, mesh: Optional[Mesh] = None, batch_capacity: int = 1024):
@@ -94,6 +98,9 @@ class ShardedEvaluator:
 
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_devices = self.mesh.devices.size
+        #: Batch sizes fed to __call__ must be multiples of this so the
+        #: leading dimension splits evenly across the mesh.
+        self.size_multiple = self.n_devices
         self.batch_capacity = pad_to_multiple(batch_capacity, self.n_devices)
         self.params = jax.device_put(params, replicated(self.mesh))
         in_shard = batch_sharding(self.mesh)
@@ -104,6 +111,6 @@ class ShardedEvaluator:
         )
 
     def __call__(self, params, indices, buckets):
-        # Signature-compatible with evaluate_batch_jit; `params` must be
-        # the tree passed at construction (already device_put).
+        # Signature-compatible with evaluate_batch_jit; `params` is
+        # ignored — the replicated tree from construction is used.
         return self._fn(self.params, indices, buckets)

@@ -106,7 +106,8 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
 
 
 def suggest_pipeline_depth(weights: "NnueWeights", size: int = 1024,
-                           rounds: int = 4, device_params=None) -> int:
+                           rounds: int = 4, device_params=None,
+                           eval_fn=None) -> int:
     """Probe whether concurrent device dispatches overlap, and suggest a
     pipeline depth for SearchService.
 
@@ -118,25 +119,35 @@ def suggest_pipeline_depth(weights: "NnueWeights", size: int = 1024,
     returns 4/2/1 as the overlap ratio falls."""
     import time
 
-    import jax
-
     from fishnet_tpu.nnue import spec
-    from fishnet_tpu.nnue.jax_eval import evaluate_batch_jit, params_from_weights
 
-    params = device_params
-    if params is None:
-        params = jax.device_put(params_from_weights(weights))
+    if eval_fn is None:
+        import jax
+
+        from fishnet_tpu.nnue.jax_eval import evaluate_batch_jit, params_from_weights
+
+        eval_fn = evaluate_batch_jit
+        params = device_params
+        if params is None:
+            params = jax.device_put(params_from_weights(weights))
+    else:
+        # Probing an external evaluator (e.g. ShardedEvaluator): it holds
+        # its own device params and must be probed itself — the dispatch
+        # overlap of the single-device jit says nothing about a sharded
+        # computation's.
+        params = device_params
+        size = _round_up(size, max(1, int(getattr(eval_fn, "size_multiple", 1))))
     feats = np.full((size, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.uint16)
     buckets = np.zeros((size,), np.int32)
-    np.asarray(evaluate_batch_jit(params, feats, buckets))  # compile + warm
+    np.asarray(eval_fn(params, feats, buckets))  # compile + warm
 
     t0 = time.perf_counter()
     for _ in range(rounds):
-        np.asarray(evaluate_batch_jit(params, feats, buckets))
+        np.asarray(eval_fn(params, feats, buckets))
     sequential = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    arrs = [evaluate_batch_jit(params, feats, buckets) for _ in range(rounds)]
+    arrs = [eval_fn(params, feats, buckets) for _ in range(rounds)]
     for a in arrs:
         np.asarray(a)
     pipelined = time.perf_counter() - t0
@@ -147,6 +158,10 @@ def suggest_pipeline_depth(weights: "NnueWeights", size: int = 1024,
     if ratio >= 1.6:
         return 2
     return 1
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
 
 
 #: Must cover the native core's largest single eval block
@@ -169,7 +184,15 @@ class SearchService:
         backend: str = "jax",  # "jax" | "scalar"
         eval_sizes: Optional[Sequence[int]] = None,
         pipeline_depth: int = 1,
+        evaluator=None,
     ) -> None:
+        """``evaluator``: optional callable ``(params, indices, buckets) ->
+        int32 [B]`` replacing the built-in single-device
+        ``evaluate_batch_jit`` — the multi-chip seam (a
+        ``parallel.mesh.ShardedEvaluator`` shards each microbatch over a
+        device mesh). Its optional ``size_multiple`` attribute forces
+        every eval-size bucket to a multiple so sharded batches split
+        evenly across devices."""
         self._lib = load()
         _bind_pool_api(self._lib)
 
@@ -183,7 +206,13 @@ class SearchService:
             net_path = self._tmp.name
         self.net_path = str(net_path)
         self.backend = backend
-        self.batch_capacity = batch_capacity = max(batch_capacity, MIN_BATCH_CAPACITY)
+        # Every batch shipped to a sharded evaluator must split evenly
+        # across its devices; force capacities and size buckets to
+        # multiples of the evaluator's shard count.
+        mult = max(1, int(getattr(evaluator, "size_multiple", 1)))
+        self.batch_capacity = batch_capacity = _round_up(
+            max(batch_capacity, MIN_BATCH_CAPACITY), mult
+        )
         # Pipeline depth: the pool's slots are partitioned into this many
         # groups, each with its own in-flight device batch. While group
         # i's eval rides the host<->device link, groups i+1.. run their
@@ -210,13 +239,19 @@ class SearchService:
         self._params = None
         self._eval_fn = None
         if backend == "jax":
-            import jax
+            if evaluator is not None:
+                self._eval_fn = evaluator
+            else:
+                import jax
 
-            from fishnet_tpu.nnue.jax_eval import evaluate_batch_jit, params_from_weights
+                from fishnet_tpu.nnue.jax_eval import (
+                    evaluate_batch_jit,
+                    params_from_weights,
+                )
 
-            w = weights if weights is not None else NnueWeights.load(net_path)
-            self._params = jax.device_put(params_from_weights(w))
-            self._eval_fn = evaluate_batch_jit
+                w = weights if weights is not None else NnueWeights.load(net_path)
+                self._params = jax.device_put(params_from_weights(w))
+                self._eval_fn = evaluate_batch_jit
 
         # Driver state. Buffers must exist before the thread starts.
         cap = batch_capacity
@@ -224,24 +259,24 @@ class SearchService:
         # together still fill one batch_capacity of in-flight work —
         # without this, k groups each padding up to the full capacity
         # bucket would multiply the host->device bytes by k.
-        self._group_capacity = max(MIN_BATCH_CAPACITY, cap // self.pipeline_depth)
+        self._group_capacity = _round_up(
+            max(MIN_BATCH_CAPACITY, cap // self.pipeline_depth), mult
+        )
         # Shape buckets for _evaluate. Each distinct size is one XLA
         # compile (slow through a device tunnel) — callers with a known
         # steady-state load should pass just two or three sizes.
         if eval_sizes is not None:
             sizes = {min(int(s), cap) for s in eval_sizes if s > 0}
-            sizes.add(cap)
-            sizes.add(self._group_capacity)  # groups fill to this bucket
-            self._eval_sizes = sorted(sizes)
         else:
             sizes = set()
             s = 64
             while s < cap:
                 sizes.add(s)
                 s *= 2
-            sizes.add(cap)
-            sizes.add(self._group_capacity)  # groups fill to this bucket
-            self._eval_sizes = sorted(sizes)
+        sizes.add(cap)
+        sizes.add(self._group_capacity)  # groups fill to this bucket
+        # Shard-align every bucket (no-op for the single-device path).
+        self._eval_sizes = sorted({min(_round_up(s, mult), cap) for s in sizes})
         # uint16 feature indices: half the host->device transfer bytes.
         # One buffer set per pipeline group: group i's buffers must stay
         # untouched while its dispatched eval is still in flight.

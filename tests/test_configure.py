@@ -39,6 +39,20 @@ def test_parse_backlog():
     assert cfg.parse_backlog("0") == 0.0
 
 
+def test_parse_mesh():
+    assert cfg.parse_mesh("auto") == "auto"
+    assert cfg.parse_mesh("off") == "off"
+    assert cfg.parse_mesh("4x2") == "4x2"
+    assert cfg.parse_mesh(" 8X1 ") == "8x1"
+    for bad in ("", "4x0", "0x2", "4x2x1", "four", "4*2"):
+        with pytest.raises(cfg.ConfigError):
+            cfg.parse_mesh(bad)
+    opt = cfg.Opt()
+    assert opt.resolved_mesh() == "auto"
+    opt.mesh = "off"
+    assert opt.resolved_mesh() == "off"
+
+
 def test_parse_key():
     assert cfg.parse_key("abcDEF123") == "abcDEF123"
     with pytest.raises(cfg.ConfigError):
