@@ -48,13 +48,19 @@ struct Slot;
 // Block requests (prefetched siblings/children) ride one suspension.
 class BatchedEval : public EvalBridge {
  public:
-  explicit BatchedEval(Slot* slot) : slot_(slot) {}
+  BatchedEval(Slot* slot, const std::atomic<int>* budget)
+      : slot_(slot), budget_(budget) {}
   int evaluate(const Position& pos) override;
   void evaluate_block(const Position* positions, int n, int32_t* out) override;
   bool batched() const override { return true; }
+  // Live view of the pool's adaptive speculation budget.
+  int prefetch_budget() const override {
+    return budget_->load(std::memory_order_relaxed);
+  }
 
  private:
   Slot* slot_;
+  const std::atomic<int>* budget_;
 };
 
 struct Slot {
@@ -117,6 +123,21 @@ int BatchedEval::evaluate(const Position& pos) {
 
 struct SearchPool {
   TranspositionTable tt;
+  // Pool-level eval-traffic accounting. Written by the scheduler thread
+  // only; read cross-thread by fc_pool_counters, hence relaxed atomics.
+  SearchCounters counters;
+  std::atomic<uint64_t> steps{0};          // device batches shipped
+  std::atomic<uint64_t> evals_shipped{0};  // eval slots across all steps
+  std::atomic<uint64_t> suspensions{0};    // fiber blocks (1 round-trip each)
+  std::atomic<uint64_t> step_capacity{0};  // sum of capacities (occupancy denom)
+  // Adaptive speculation budget (max speculative evals per prefetch
+  // block). Halved whenever a step overflows capacity — wasted slots
+  // then displace other fibers' demand evals — and grown back while
+  // batches run at most half full, where an unshipped prefetch would
+  // just leave device capacity idle and cost a later round-trip.
+  // Written by the scheduler thread, read by it too (via the bridge);
+  // atomic only for the telemetry read.
+  std::atomic<int> prefetch_budget{EVAL_BLOCK_MAX};
   std::unique_ptr<NnueNet> scalar_net;
   std::unique_ptr<ScalarEval> scalar_eval;
   HceEval hce_eval;  // variant searches (immediate, CPU)
@@ -228,7 +249,8 @@ int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
     slot.active = false;
     return -4;
   }
-  if (!slot.bridge) slot.bridge = std::make_unique<BatchedEval>(&slot);
+  if (!slot.bridge)
+    slot.bridge = std::make_unique<BatchedEval>(&slot, &pool->prefetch_budget);
   return id;
 }
 
@@ -261,6 +283,8 @@ bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
   Slot& slot = *pool->slots[i];
   int base = int(batch.size());
   if (base + slot.block_n > capacity) return false;  // wait for next step
+  // One fiber block served by this device round-trip.
+  pool->suspensions.fetch_add(1, std::memory_order_relaxed);
   for (int j = 0; j < slot.block_n; j++) {
     int idx = base + j;
     memcpy(out_features + size_t(idx) * 2 * NNUE_MAX_ACTIVE,
@@ -282,6 +306,7 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
   const size_t n_slots = pool->slots.size();
   const int n_groups = pool->n_groups;
   size_t cursor = pool->group_cursor[group];
+  bool overflow = false;
 
   // Phase 1: fibers still suspended from a previous over-capacity step
   // have waited longest — serve them before any freshly-produced blocks
@@ -291,8 +316,9 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
     if (int(i) % n_groups != group) continue;
     Slot& slot = *pool->slots[i];
     if (!slot.active || slot.finished || !slot.wants_eval) continue;
-    emit_block(pool, batch, int(i), out_features, out_buckets, out_slots,
-               capacity);
+    if (!emit_block(pool, batch, int(i), out_features, out_buckets, out_slots,
+                    capacity))
+      overflow = true;
   }
 
   // Phase 2: run every runnable fiber to its next leaf; emit the blocks
@@ -315,7 +341,7 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
           : slot.use_scalar
               ? static_cast<EvalBridge*>(pp->scalar_eval.get())
               : static_cast<EvalBridge*>(slot.bridge.get());
-      slot.search = std::make_unique<Search>(&pp->tt, eval);
+      slot.search = std::make_unique<Search>(&pp->tt, eval, &pp->counters);
       slot.fiber->start([sp] {
         sp->result = sp->search->run(sp->root, sp->history, sp->limits);
       });
@@ -327,10 +353,11 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
       slot.finished = true;
       pool->finished_queue.push_back(int(i));
     } else if (slot.wants_eval) {
-      emit_block(pool, batch, int(i), out_features, out_buckets, out_slots,
-                 capacity);
       // Blocks that don't fit stay suspended; phase 1 of the next step
       // picks them up first.
+      if (!emit_block(pool, batch, int(i), out_features, out_buckets,
+                      out_slots, capacity))
+        overflow = true;
     }
   }
 
@@ -338,7 +365,45 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
   if (!batch.empty())
     pool->group_cursor[group] = (size_t(batch.back().first) + 1) % n_slots;
 
+  if (!batch.empty()) {
+    // Only non-empty steps ship a device batch; idle polls don't count
+    // against occupancy.
+    pool->steps.fetch_add(1, std::memory_order_relaxed);
+    pool->step_capacity.fetch_add(uint64_t(capacity), std::memory_order_relaxed);
+    pool->evals_shipped.fetch_add(batch.size(), std::memory_order_relaxed);
+    // Adapt the speculation budget to batch pressure (see the field's
+    // comment): multiplicative decrease on overflow, slow additive
+    // growth while there is slack.
+    int budget = pool->prefetch_budget.load(std::memory_order_relaxed);
+    if (overflow)
+      pool->prefetch_budget.store(std::max(1, budget / 2),
+                                  std::memory_order_relaxed);
+    else if (int(batch.size()) * 2 < capacity && budget < EVAL_BLOCK_MAX)
+      pool->prefetch_budget.store(budget + 1, std::memory_order_relaxed);
+  }
   return int(batch.size());
+}
+
+// Cumulative eval-traffic counters, for bench/telemetry:
+// [0] steps (device batches shipped)   [1] eval slots shipped
+// [2] fiber suspensions served         [3] sum of step capacities
+// [4] demand evals                     [5] prefetched (speculative) evals
+// [6] prefetch hits                    [7] TT static-eval hits
+// [8] current prefetch budget (adaptive; instantaneous, not cumulative)
+int fc_pool_counters(SearchPool* pool, uint64_t* out, int n) {
+  constexpr auto R = std::memory_order_relaxed;
+  const uint64_t vals[9] = {
+      pool->steps.load(R),          pool->evals_shipped.load(R),
+      pool->suspensions.load(R),    pool->step_capacity.load(R),
+      pool->counters.demand_evals.load(R),
+      pool->counters.prefetch_shipped.load(R),
+      pool->counters.prefetch_hits.load(R),
+      pool->counters.tt_eval_hits.load(R),
+      uint64_t(pool->prefetch_budget.load(R)),
+  };
+  int k = n < 9 ? n : 9;
+  for (int i = 0; i < k; i++) out[i] = vals[i];
+  return k;
 }
 
 // Provide centipawn scores for the group's last step() batch, in order.

@@ -12,6 +12,10 @@ namespace fc {
 namespace {
 constexpr int16_t EVAL_NONE = TT_EVAL_NONE;
 
+// Approximate piece values for delta pruning (qsearch) — margins only,
+// never part of a returned score.
+constexpr int kPieceValue[PIECE_TYPE_NB] = {100, 320, 330, 500, 950, 0};
+
 size_t floor_pow2(size_t n) {
   size_t p = 1;
   while (p * 2 <= n) p *= 2;
@@ -53,10 +57,13 @@ void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
   }
 }
 
-void TranspositionTable::store_eval(uint64_t key, int eval) {
+void TranspositionTable::store_eval(uint64_t key, int eval, bool speculative) {
   TTEntry* e = &entries_[key & mask_];
   if (e->key == key) {
-    if (e->eval == TT_EVAL_NONE) e->eval = int16_t(eval);
+    if (e->eval == TT_EVAL_NONE) {
+      e->eval = int16_t(eval);
+      e->prefetched = speculative ? 1 : 0;
+    }
     return;
   }
   // Only claim genuinely empty entries: a speculative eval (many of which
@@ -69,6 +76,7 @@ void TranspositionTable::store_eval(uint64_t key, int eval) {
     e->depth = 0;
     e->bound = TT_NONE;
     e->gen = gen_;
+    e->prefetched = speculative ? 1 : 0;
   }
 }
 
@@ -97,6 +105,7 @@ int Search::evaluate(const Position& pos) {
   // Clamp into the non-mate score range: keeps TT int16 storage exact,
   // avoids the TT_EVAL_NONE sentinel, and prevents huge (e.g. random-net)
   // evals from masquerading as mate scores.
+  if (counters_) counters_->bump(counters_->demand_evals);
   int v = eval_->evaluate(pos);
   constexpr int LIMIT = VALUE_MATE_IN_MAX - 1;
   return v < -LIMIT ? -LIMIT : (v > LIMIT ? LIMIT : v);
@@ -137,6 +146,10 @@ bool Search::is_repetition_or_50(const Position& pos, int) const {
 // Move-ordering scores (higher = earlier).
 void Search::order_moves(const Position& pos, MoveList& moves, Move tt_move,
                          int ply) {
+  Move prev = ply > 0 && ply <= MAX_PLY ? move_stack_[ply] : MOVE_NONE;
+  Move counter = prev != MOVE_NONE
+                     ? countermove_[move_from(prev)][move_to(prev)]
+                     : MOVE_NONE;
   int scores[MAX_MOVES];
   for (int i = 0; i < moves.size; i++) {
     Move m = moves.moves[i];
@@ -154,6 +167,11 @@ void Search::order_moves(const Position& pos, MoveList& moves, Move tt_move,
     } else if (ply < MAX_PLY &&
                (m == killers_[ply][0] || m == killers_[ply][1])) {
       score = 1 << 16;
+    } else if (m == counter) {
+      // The stored refutation of the opponent's previous move: below
+      // killers (position-specific beats move-specific) but above plain
+      // history.
+      score = 1 << 15;
     } else {
       Color us = pos.stm;
       score = history_[us][move_from(m)][move_to(m)];
@@ -176,7 +194,7 @@ void Search::order_moves(const Position& pos, MoveList& moves, Move tt_move,
 }
 
 int Search::prefetch_evals(const Position& pos, const MoveList& children,
-                           bool captures_only, bool include_self) {
+                           bool include_self, int max_children) {
   // Block buffers live on the Search object, not the fiber stack (24
   // Position copies would blow the per-frame stack budget). Safe: the
   // block completes before any recursion, so this is never re-entered.
@@ -186,11 +204,10 @@ int Search::prefetch_evals(const Position& pos, const MoveList& children,
     prefetch_keys_[k] = pos.hash;
     k++;
   }
+  int limit = include_self ? max_children + 1 : max_children;
+  if (limit > EVAL_BLOCK_MAX) limit = EVAL_BLOCK_MAX;
   for (Move m : children) {
-    if (k >= EVAL_BLOCK_MAX) break;
-    if (captures_only && pos.empty(move_to(m)) && move_kind(m) != MK_EN_PASSANT &&
-        move_promo(m) != QUEEN)
-      continue;
+    if (k >= limit) break;
     Position child = pos;
     child.make(m);
     if (child.in_check()) continue;  // won't stand pat; eval unused
@@ -202,14 +219,20 @@ int Search::prefetch_evals(const Position& pos, const MoveList& children,
     k++;
   }
   if (k == 0) return 0;
+  if (counters_) {
+    if (include_self) counters_->bump(counters_->demand_evals);
+    counters_->bump(counters_->prefetch_shipped,
+                    uint64_t(k) - (include_self ? 1 : 0));
+  }
   int32_t vals[EVAL_BLOCK_MAX];
   eval_->evaluate_block(prefetch_block_, k, vals);
   constexpr int LIMIT = VALUE_MATE_IN_MAX - 1;
   int self_value = 0;
   for (int i = 0; i < k; i++) {
     int v = vals[i] < -LIMIT ? -LIMIT : (vals[i] > LIMIT ? LIMIT : vals[i]);
-    if (include_self && i == 0) self_value = v;
-    tt_->store_eval(prefetch_keys_[i], v);
+    bool self = include_self && i == 0;
+    if (self) self_value = v;
+    tt_->store_eval(prefetch_keys_[i], v, /*speculative=*/!self);
   }
   return self_value;
 }
@@ -250,50 +273,82 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
 
   int best = -VALUE_INF;
 
+  // Targets: in check (or under the antichess capture obligation) every
+  // move; otherwise captures/promotions only. Built lazily and ORDERED
+  // before any prefetch, so speculative evals go to the moves the loop
+  // below actually visits first — but a TT-hit stand-pat cutoff (the
+  // most common qsearch outcome) returns before paying for any of it.
+  MoveList targets;
+  auto build_targets = [&]() {
+    if (in_check || forced_captures) {
+      targets = moves;
+    } else {
+      for (Move m : moves)
+        if (!pos.empty(move_to(m)) || move_kind(m) == MK_EN_PASSANT ||
+            move_promo(m) == QUEEN)
+          targets.push(m);
+    }
+    order_moves(pos, targets, MOVE_NONE, ply);
+  };
+
   if (in_check || forced_captures) {
-    // Every evasion is searched below and most land in quiet positions
-    // needing a stand-pat eval: fetch them all in one round-trip.
-    // (Only worthwhile when evals actually batch; the scalar eval would
-    // eagerly pay for children a beta cutoff never visits.)
+    build_targets();
+    // Evasions are searched below and most land in quiet positions
+    // needing a stand-pat eval: fetch them (best-ordered first, within
+    // the pool's current speculation budget) in one round-trip.
     if (eval_->batched())
-      prefetch_evals(pos, moves, /*captures_only=*/false, /*include_self=*/false);
+      prefetch_evals(pos, targets, /*include_self=*/false,
+                     eval_->prefetch_budget());
   } else {
     // Stand pat, with the TT's cached static eval when available. On a
-    // miss, evaluate this node AND its capture children in one
+    // miss, evaluate this node AND its best capture children in one
     // round-trip — the recursion below then stands pat from the TT.
     bool hit;
     TTEntry* tte = tt_->probe(pos.hash, hit);
     int stand;
     if (hit && tte->eval != EVAL_NONE) {
       stand = tte->eval;
-    } else if (eval_->batched()) {
-      stand = prefetch_evals(pos, moves, /*captures_only=*/true,
-                             /*include_self=*/true);
+      if (counters_) {
+        counters_->bump(counters_->tt_eval_hits);
+        if (tte->prefetched) {
+          counters_->bump(counters_->prefetch_hits);
+          tte->prefetched = 0;  // count each speculative eval once
+        }
+      }
+      if (stand >= beta) return stand;  // before any targets/order work
+      build_targets();
     } else {
-      stand = evaluate(pos);
-      tt_->store_eval(pos.hash, stand);
+      build_targets();
+      if (eval_->batched()) {
+        stand = prefetch_evals(pos, targets, /*include_self=*/true,
+                               eval_->prefetch_budget());
+      } else {
+        stand = evaluate(pos);
+        tt_->store_eval(pos.hash, stand);
+      }
+      if (stand >= beta) return stand;
     }
-    if (stand >= beta) return stand;
     if (stand > alpha) alpha = stand;
     best = stand;
   }
 
-  // In check (or under the antichess capture obligation): search every
-  // move. Otherwise captures/promotions only.
-  MoveList targets;
-  if (in_check || forced_captures) {
-    targets = moves;
-  } else {
-    for (Move m : moves)
-      if (!pos.empty(move_to(m)) || move_kind(m) == MK_EN_PASSANT ||
-          move_promo(m) == QUEEN)
-        targets.push(m);
-  }
-  order_moves(pos, targets, MOVE_NONE, ply);
-
   for (Move m : targets) {
+    // Delta pruning: even winning this capture outright cannot bring the
+    // score near alpha. Skipped in check / under forced captures (no
+    // stand-pat bound there) and for promotions (the gain is larger).
+    if (!in_check && !forced_captures && best > -VALUE_MATE_IN_MAX &&
+        std::abs(alpha) < VALUE_MATE_IN_MAX &&
+        move_promo(m) == NO_PIECE_TYPE) {
+      int victim = move_kind(m) == MK_EN_PASSANT
+                       ? PAWN
+                       : piece_type(pos.piece_on(move_to(m)));
+      if (victim >= 0 && victim < PIECE_TYPE_NB &&
+          best + kPieceValue[victim] + 200 <= alpha)
+        continue;
+    }
     Position copy = pos;
     copy.make(m);
+    if (ply + 1 <= MAX_PLY) move_stack_[ply + 1] = m;
     int value = -qsearch(copy, -beta, -alpha, ply + 1);
     if (stopped_) return best > -VALUE_INF ? best : 0;
     if (value > best) {
@@ -350,6 +405,44 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
       return v;
   }
 
+  // Static eval of this node, for the eval-gated prunings below. On the
+  // batched bridge an eval costs a device round-trip, which pruning can
+  // never repay — use it only when the TT already has it (prior
+  // iterations and speculative prefetches populate the cache). The
+  // scalar path evaluates directly: its eval is a few microseconds.
+  int static_eval = TT_EVAL_NONE;
+  if (!in_check) {
+    if (hit && tte->eval != EVAL_NONE) {
+      static_eval = tte->eval;
+      if (counters_) {
+        counters_->bump(counters_->tt_eval_hits);
+        if (tte->prefetched) {
+          counters_->bump(counters_->prefetch_hits);
+          tte->prefetched = 0;
+        }
+      }
+    } else if (!eval_->batched()) {
+      static_eval = evaluate(pos);
+      tt_->store_eval(pos.hash, static_eval);
+    }
+  }
+  bool have_eval = static_eval != TT_EVAL_NONE;
+
+  // Reverse futility (static beta) pruning: far enough above beta that a
+  // shallow search will not drop back under it.
+  if (!is_pv && !in_check && ply > 0 && depth <= 6 && have_eval &&
+      std::abs(beta) < VALUE_MATE_IN_MAX && static_eval - 80 * depth >= beta)
+    return static_eval;
+
+  // Razoring: hopeless at shallow depth — verify with qsearch and trust
+  // a confirming fail-low.
+  if (!is_pv && !in_check && ply > 0 && depth <= 2 && have_eval &&
+      static_eval + 240 * depth < alpha) {
+    int v = qsearch(pos, alpha - 1, alpha, ply);
+    if (stopped_) return 0;
+    if (v < alpha) return v;
+  }
+
   // Null-move pruning: skip a turn; if we still beat beta at reduced
   // depth, the node is almost certainly a fail-high. Requires non-pawn
   // material to avoid zugzwang traps.
@@ -358,6 +451,7 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
     Position copy = pos;
     copy.make_null();
     path_.push_back(copy.hash);
+    move_stack_[ply + 1] = MOVE_NONE;
     int v = -alpha_beta(copy, -beta, -beta + 1, depth - 3, ply + 1, false);
     path_.pop_back();
     if (stopped_) return 0;
@@ -373,11 +467,11 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
 
   order_moves(pos, moves, tt_move, ply);
 
-  // Frontier prefetch: at depth 1 every child is about to become a
-  // qsearch root needing a stand-pat eval — fetch them all in one
-  // round-trip instead of one each.
+  // Frontier prefetch: at depth 1 each visited child becomes a qsearch
+  // root needing a stand-pat eval — fetch them (ordered, within the
+  // pool's speculation budget) in one round-trip instead of one each.
   if (depth == 1 && eval_->batched())
-    prefetch_evals(pos, moves, /*captures_only=*/false, /*include_self=*/false);
+    prefetch_evals(pos, moves, /*include_self=*/false, eval_->prefetch_budget());
 
   Move best_move = MOVE_NONE;
   int best = -VALUE_INF;
@@ -390,9 +484,29 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
       continue;
     move_count++;
 
+    bool is_quiet = pos.empty(move_to(m)) && move_kind(m) != MK_EN_PASSANT &&
+                    move_promo(m) == NO_PIECE_TYPE;
+
     Position copy = pos;
     copy.make(m);
+
+    // Shallow-depth quiet pruning, only once a real score is banked
+    // (best > -INF) so a forced line is never pruned into a false mate/
+    // stalemate. Checking moves are exempt: they are exactly the quiets
+    // a static margin misjudges.
+    if (!is_pv && !in_check && is_quiet && best > -VALUE_INF &&
+        std::abs(alpha) < VALUE_MATE_IN_MAX && !copy.in_check()) {
+      // Late move pruning: quiets this deep in the ordered list at
+      // shallow depth almost never raise alpha.
+      if (depth <= 4 && move_count > 4 + depth * depth) continue;
+      // Futility: static eval so far below alpha that a quiet move
+      // cannot recover within the remaining depth.
+      if (depth <= 3 && have_eval && static_eval + 120 * depth + 100 <= alpha)
+        continue;
+    }
+
     path_.push_back(copy.hash);
+    move_stack_[ply + 1] = m;
 
     int value;
     if (move_count == 1) {
@@ -426,13 +540,19 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
           pv_len_[ply] = pv_len_[ply + 1] + 1;
         }
         if (alpha >= beta) {
-          // Killer/history bookkeeping for quiet cutoffs.
+          // Killer/history/countermove bookkeeping for quiet cutoffs.
           if (pos.empty(move_to(m)) && move_kind(m) != MK_EN_PASSANT) {
             if (killers_[ply][0] != m) {
               killers_[ply][1] = killers_[ply][0];
               killers_[ply][0] = m;
             }
-            history_[pos.stm][move_from(m)][move_to(m)] += depth * depth;
+            // Saturate below the countermove bonus (1 << 15) so raw
+            // history can never outrank the structured heuristics.
+            int& h = history_[pos.stm][move_from(m)][move_to(m)];
+            if (h < (1 << 14)) h += depth * depth;
+            Move prev = ply > 0 ? move_stack_[ply] : MOVE_NONE;
+            if (prev != MOVE_NONE)
+              countermove_[move_from(prev)][move_to(prev)] = m;
           }
           break;
         }
@@ -466,6 +586,8 @@ SearchResult Search::run(const Position& root,
   root_history_len_ = path_.size();
   memset(killers_, 0xFF, sizeof(killers_));
   memset(history_, 0, sizeof(history_));
+  memset(countermove_, 0xFF, sizeof(countermove_));  // MOVE_NONE fill
+  move_stack_[0] = MOVE_NONE;
   tt_->new_generation();
 
   MoveList root_moves;

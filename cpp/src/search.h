@@ -51,6 +51,11 @@ class EvalBridge {
   // batching). Speculative prefetches only pay off then; on a scalar
   // CPU eval they are pure waste.
   virtual bool batched() const { return false; }
+  // How many SPECULATIVE evals a prefetch block may carry right now.
+  // The pool shrinks this under batch-capacity pressure (wasted slots
+  // then steal capacity from other fibers) and grows it back when the
+  // device batch has room (a missed prefetch costs a whole round-trip).
+  virtual int prefetch_budget() const { return EVAL_BLOCK_MAX; }
 };
 
 class ScalarEval : public EvalBridge {
@@ -85,6 +90,10 @@ struct TTEntry {
   uint8_t depth = 0;
   uint8_t bound = TT_NONE;
   uint16_t gen = 0;
+  // The cached eval came from a speculative prefetch and has not been
+  // consumed yet (cleared on first use) — feeds the prefetch hit-rate
+  // counter so the block policy can be tuned against measurements.
+  uint8_t prefetched = 0;
 };
 
 class TranspositionTable {
@@ -95,7 +104,8 @@ class TranspositionTable {
   // Cache a speculative static eval without ever evicting an entry that
   // carries a search bound for a different key — prefetched evals are
   // cheap and must not degrade the shared table's hit quality.
-  void store_eval(uint64_t key, int eval);
+  // `speculative` tags the entry for prefetch hit-rate accounting.
+  void store_eval(uint64_t key, int eval, bool speculative = false);
   void new_generation() { gen_++; }
 
  private:
@@ -105,6 +115,23 @@ class TranspositionTable {
 };
 
 // -- search ---------------------------------------------------------------
+
+// Shared eval-traffic accounting. Single writer (the scheduler thread
+// that runs all search fibers), but read cross-thread by telemetry
+// (fc_pool_counters from the Python event loop), so the fields are
+// relaxed atomics: individual values are exact, ratios may lag a step.
+//   occupancy    = evals_shipped / (steps * capacity)   [pool side]
+//   prefetch ROI = prefetch_hits / prefetch_shipped
+//   cache rate   = tt_eval_hits / (tt_eval_hits + demand_evals)
+struct SearchCounters {
+  std::atomic<uint64_t> demand_evals{0};     // evals needed right now
+  std::atomic<uint64_t> prefetch_shipped{0}; // speculative evals shipped
+  std::atomic<uint64_t> prefetch_hits{0};    // speculative evals consumed
+  std::atomic<uint64_t> tt_eval_hits{0};     // evals answered from the TT
+  void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
+    c.fetch_add(n, std::memory_order_relaxed);
+  }
+};
 
 struct SearchLimits {
   uint64_t nodes = 0;  // 0 = unlimited
@@ -133,7 +160,9 @@ struct SearchResult {
 
 class Search {
  public:
-  Search(TranspositionTable* tt, EvalBridge* eval) : tt_(tt), eval_(eval) {}
+  Search(TranspositionTable* tt, EvalBridge* eval,
+         SearchCounters* counters = nullptr)
+      : tt_(tt), eval_(eval), counters_(counters) {}
 
   // Run a full iterative-deepening search. game_history: Zobrist hashes
   // of positions before root (for repetition detection), most recent last.
@@ -145,17 +174,23 @@ class Search {
                  bool is_pv);
   int qsearch(const Position& pos, int alpha, int beta, int ply);
   int evaluate(const Position& pos);
-  // Evaluate `pos` plus up to EVAL_BLOCK_MAX-1 of the given children in
+  // Evaluate `pos` plus up to `max_children` of the given children in
   // one round-trip, caching every result as a TT static eval. Children
   // that are in check or already TT-cached are skipped. Returns pos's
   // eval. `include_self`=false prefetches children only (returns 0).
+  // Pass the children PRE-ORDERED (and pre-filtered to the moves the
+  // caller will actually search) and cap `max_children` to the count
+  // likely to be visited: measured speculative hit rates collapse past
+  // the first few moves (a cut node visits ~1-2), and every unconsumed
+  // eval steals batch capacity from another fiber.
   int prefetch_evals(const Position& pos, const MoveList& children,
-                     bool captures_only, bool include_self);
+                     bool include_self, int max_children);
   bool is_repetition_or_50(const Position& pos, int ply) const;
   void order_moves(const Position& pos, MoveList& moves, Move tt_move, int ply);
 
   TranspositionTable* tt_;
   EvalBridge* eval_;
+  SearchCounters* counters_ = nullptr;
   uint64_t nodes_ = 0;
   uint64_t node_limit_ = 0;
   bool stopped_ = false;
@@ -167,6 +202,14 @@ class Search {
   size_t root_history_len_ = 0;
   Move killers_[MAX_PLY][2];
   int history_[COLOR_NB][64][64];
+  // Countermove heuristic: the quiet refutation of the opponent's last
+  // move (indexed by its from/to squares). Deliberately no continuation
+  // history: at [6][64][6][64] x int16 it would cost ~300 KB per Search,
+  // and thousands of concurrent pool slots each own a Search.
+  Move countermove_[64][64];
+  // move_stack_[p] = the move that led to the node at ply p (MOVE_NONE
+  // at the root and after a null move); feeds countermove bookkeeping.
+  Move move_stack_[MAX_PLY + 1];
   Move pv_table_[MAX_PLY][MAX_PLY];
   int pv_len_[MAX_PLY];
   std::vector<Move> excluded_root_moves_;  // for MultiPV iteration

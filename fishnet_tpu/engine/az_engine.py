@@ -31,7 +31,21 @@ from fishnet_tpu.search.mcts import MctsConfig, MctsPool, MctsResult
 # costs ~3 orders of magnitude more compute, so scale the protocol's node
 # budget down to a visit budget (reference servers send ~1.5M nodes;
 # /1024 gives ~1.5k visits, a sound default analysis depth for a net).
+# This static mapping is only the CEILING: the service measures actual
+# visits/second (EWMA, same pattern as utils/stats.py NpsRecorder) and
+# the per-search budget is clamped so a slow net or a loaded batch still
+# finishes inside the server's per-ply timeout
+# (reference doc/protocol.md:32: e.g. 7000 ms).
 NODES_PER_VISIT = 1024
+
+#: Floor on any analysis visit budget: below this the PV/score are too
+#: noisy to submit even under deadline pressure; the hard movetime stop
+#: is what actually guarantees the timeout then.
+MIN_ANALYSIS_VISITS = 64
+
+#: Fraction of the per-ply timeout the calibrated budget aims at,
+#: leaving headroom for queueing + harvest latency.
+TIMEOUT_TARGET_FRACTION = 0.8
 
 
 @dataclass
@@ -53,6 +67,10 @@ class AzMctsService:
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stopping = False
+        # Measured visits/second (EWMA alpha=0.9, the stats.py pattern),
+        # observed per completed search UNDER LOAD — so it already folds
+        # in batching/queueing delays, which is what deadline math needs.
+        self._visit_rate: Optional[float] = None
         self._thread = threading.Thread(target=self._drive, daemon=True,
                                         name="az-mcts-driver")
         self._thread.start()
@@ -81,6 +99,12 @@ class AzMctsService:
                 self._cancelled_tokens.add(token)
             self._wake.set()
             raise
+
+    def visits_per_second(self) -> Optional[float]:
+        """Measured per-search visit throughput; None until the first
+        completed search."""
+        with self._lock:
+            return self._visit_rate
 
     def close(self) -> None:
         with self._lock:
@@ -156,6 +180,13 @@ class AzMctsService:
             for sid in self.pool.finished():
                 p = self._pending.pop(sid, None)
                 result = self.pool.harvest(sid)
+                if result.visits > 0 and result.time_seconds > 0.02:
+                    rate = result.visits / result.time_seconds
+                    with self._lock:
+                        self._visit_rate = (
+                            rate if self._visit_rate is None
+                            else 0.9 * self._visit_rate + 0.1 * rate
+                        )
                 if p is not None:
                     p.loop.call_soon_threadsafe(_set_result_if_waiting,
                                                 p.future, result)
@@ -191,9 +222,23 @@ class AzMctsEngine(Engine):
         work = position.work
         if work.is_analysis:
             nodes = work.nodes.get(position.flavor.eval_flavor())
-            visits = max(64, nodes // NODES_PER_VISIT)
+            visits = max(MIN_ANALYSIS_VISITS, nodes // NODES_PER_VISIT)
             movetime = None
             multipv = work.effective_multipv()
+            timeout = work.timeout_seconds()
+            if timeout > 0:
+                # Calibrate the visit budget to the measured rate so the
+                # search *plans* to finish inside the per-ply timeout,
+                # and arm the movetime watchdog as the hard guarantee
+                # (an early stop still returns the partial result).
+                rate = self.service.visits_per_second()
+                if rate is not None:
+                    visits = min(
+                        visits,
+                        max(MIN_ANALYSIS_VISITS,
+                            int(rate * timeout * TIMEOUT_TARGET_FRACTION)),
+                    )
+                movetime = timeout
         else:
             level = work.level
             visits = 1 << 20  # bounded by movetime, not visits

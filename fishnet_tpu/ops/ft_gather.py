@@ -37,34 +37,59 @@ def _xla_ft_accumulate(ft_w: jax.Array, ft_b: jax.Array, indices: jax.Array) -> 
 
 
 def _kernel(idx_ref, ft_ref, bias_ref, out_ref, rows, sems):
+    # Software-pipelined gather: scratch holds TWO positions' rows. Grid
+    # step b waits on the buffer its predecessor filled for it, issues
+    # position b+1's row DMAs into the other buffer, then reduces — so
+    # ~2x MAX_ACTIVE row copies are in flight at all times and the HBM
+    # pipe never drains between positions. Row addresses come from the
+    # scalar-prefetched index operand, available before the body runs.
     b = pl.program_id(0)
-    n_active = rows.shape[0] // 2  # both perspectives share the scratch
+    n = pl.num_programs(0)
+    n_active = rows.shape[1] // 2  # both perspectives share a buffer
 
-    # Issue every row copy up front — the DMA engine overlaps them — then
-    # wait and reduce. Each feature row is viewed as one native (8, 128)
-    # int16 tile, so single-row HBM slices stay tile-aligned. Padded
-    # slots point at the sentinel zero row, so no branches are needed.
-    copies = []
+    def issue(pos, slot):
+        # Each feature row is one native (sub, 128) int16 tile, so
+        # single-row HBM slices stay tile-aligned. Padded index slots
+        # point at the sentinel zero row: no branches needed.
+        for p in range(2):
+            for k in range(n_active):
+                pltpu.make_async_copy(
+                    ft_ref.at[idx_ref[pos, p, k]],
+                    rows.at[slot, p * n_active + k],
+                    sems.at[slot, p * n_active + k],
+                ).start()
+
+    slot = jax.lax.rem(b, 2)
+
+    @pl.when(b == 0)
+    def _():
+        issue(0, 0)
+
+    @pl.when(b + 1 < n)
+    def _():
+        issue(b + 1, jax.lax.rem(b + 1, 2))
+
     for p in range(2):
         for k in range(n_active):
-            dma = pltpu.make_async_copy(
-                ft_ref.at[idx_ref[b, p, k]], rows.at[p * n_active + k],
-                sems.at[p * n_active + k],
-            )
-            dma.start()
-            copies.append(dma)
-    for dma in copies:
-        dma.wait()
+            pltpu.make_async_copy(
+                ft_ref.at[idx_ref[b, p, k]],
+                rows.at[slot, p * n_active + k],
+                sems.at[slot, p * n_active + k],
+            ).wait()
 
     bias = bias_ref[:].astype(jnp.int32)
-    all_rows = rows[:].astype(jnp.int32)  # [2A, 8S, 128]
+    all_rows = rows[slot].astype(jnp.int32)  # [2A, sub, 128]
     out_ref[0, 0] = bias + jnp.sum(all_rows[:n_active], axis=0)
     out_ref[0, 1] = bias + jnp.sum(all_rows[n_active:], axis=0)
 
 
 # Positions per pallas_call: the scalar-prefetch index operand lives in
-# SMEM (1 MiB total), so the whole batch's indices cannot ride one call.
-_CHUNK = 256
+# SMEM (1 MiB, shared with Mosaic's own scalar state — 1024-position
+# chunks overflow it by a hair), so the whole batch's indices cannot
+# ride one call; each call costs a launch plus a pipeline fill/drain,
+# so use the largest chunk that reliably fits ([512, 2, 32] int32 =
+# 128 KiB).
+_CHUNK = 512
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -95,8 +120,8 @@ def _pallas_ft_accumulate(
                 (1, 2, sub, 128), lambda b, idx_ref: (b, 0, 0, 0)
             ),
             scratch_shapes=[
-                pltpu.VMEM((2 * n_active, sub, 128), ft_w.dtype),
-                pltpu.SemaphoreType.DMA((2 * n_active,)),
+                pltpu.VMEM((2, 2 * n_active, sub, 128), ft_w.dtype),
+                pltpu.SemaphoreType.DMA((2, 2 * n_active)),
             ],
         )
         return pl.pallas_call(

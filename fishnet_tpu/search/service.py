@@ -102,6 +102,10 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     ]
     lib.fc_pool_result_line.restype = ctypes.c_int
     lib.fc_pool_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.fc_pool_counters.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
+    lib.fc_pool_counters.restype = ctypes.c_int
     lib._pool_bound = True
 
 
@@ -360,6 +364,20 @@ class SearchService:
     def poke(self) -> None:
         """Wake the driver (after setting a search's stop_event)."""
         self._wake.set()
+
+    def counters(self) -> Dict[str, int]:
+        """Cumulative eval-traffic counters from the native pool —
+        the measurements behind occupancy / prefetch-ROI / cache-rate
+        (see cpp SearchCounters). Safe to read at any time; values are
+        monotone and single-writer."""
+        buf = (ctypes.c_uint64 * 9)()
+        n = self._lib.fc_pool_counters(self._pool, buf, 9)
+        keys = (
+            "steps", "evals_shipped", "suspensions", "step_capacity",
+            "demand_evals", "prefetch_shipped", "prefetch_hits",
+            "tt_eval_hits", "prefetch_budget",
+        )
+        return {k: int(buf[i]) for i, k in enumerate(keys[:n])}
 
     def is_alive(self) -> bool:
         """False once the service is shut down or its driver crashed —

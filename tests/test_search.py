@@ -146,3 +146,38 @@ async def test_tiny_batch_capacity_clamped():
         assert res.best_move
     finally:
         svc.close()
+
+
+async def test_eval_traffic_counters_and_adaptive_budget():
+    """The pool's eval-traffic counters must account for every shipped
+    slot (demand + speculative), and the speculation budget must shrink
+    under batch-capacity pressure: many fibers sharing a small batch
+    would otherwise starve each other with wasted prefetch slots."""
+    svc = SearchService(
+        weights=NnueWeights.random(seed=9),
+        pool_slots=64,
+        batch_capacity=40,  # MIN_BATCH_CAPACITY: heavy pressure
+        tt_bytes=4 << 20,
+        backend="jax",
+    )
+    try:
+        tasks = [
+            svc.search(
+                "r1bqkbnr/pppp1ppp/2n5/4p3/4P3/5N2/PPPP1PPP/RNBQKB1R w KQkq - 2 3",
+                [], nodes=600,
+            )
+            for _ in range(32)
+        ]
+        results = await asyncio.gather(*tasks)
+        assert all(r.best_move for r in results)
+        c = svc.counters()
+        assert c["steps"] > 0
+        assert c["suspensions"] > 0
+        assert c["evals_shipped"] == c["demand_evals"] + c["prefetch_shipped"]
+        assert c["evals_shipped"] <= c["step_capacity"]
+        assert c["prefetch_hits"] <= c["prefetch_shipped"]
+        # 32 fibers x blocks into a 40-slot batch overflows constantly;
+        # the multiplicative-decrease path must have engaged.
+        assert c["prefetch_budget"] < 40
+    finally:
+        svc.close()
