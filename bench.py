@@ -92,17 +92,20 @@ def bench_device_evaluator() -> dict:
     params = jax.device_put(params_from_weights(NnueWeights.random(seed=7)))
 
     @jax.jit
-    def eval_loop(params, indices, buckets, rounds):
+    def eval_loop(params, indices, buckets, parent, rounds):
         def body(i, acc):
-            idx = jnp.roll(indices, i, axis=0)
+            # Block-aligned roll: varies the work per iteration (so XLA
+            # cannot hoist it) while keeping incremental entries aligned
+            # with their parent references.
+            idx = jnp.roll(indices, i * 8, axis=0)
             b = (buckets + i) % spec.NUM_PSQT_BUCKETS
-            return acc + evaluate_batch(params, idx, b).sum()
+            return acc + evaluate_batch(params, idx, b, parent).sum()
 
         return jax.lax.fori_loop(0, rounds, body, jnp.int32(0))
 
     rng = np.random.default_rng(0)
-    out = {}
-    for size in (1024, 16384):
+
+    def full_workload(size):
         indices = np.full(
             (size, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.int32
         )
@@ -112,9 +115,41 @@ def bench_device_evaluator() -> dict:
                 indices[b, p, :k] = np.sort(
                     rng.choice(spec.NUM_FEATURES, k, replace=False)
                 )
+        return indices, np.full((size,), -1, np.int32)
+
+    def block_workload(size, block=8):
+        # Search-shaped traffic: 1 full parent + (block-1) incremental
+        # children per block, the shape the native pool actually ships.
+        indices, parent = full_workload(size)
+        for start in range(0, size, block):
+            for j in range(1, block):
+                e = start + j
+                indices[e] = spec.NUM_FEATURES
+                for p in range(2):
+                    indices[e, p, :2] = rng.choice(
+                        spec.NUM_FEATURES, 2, replace=False
+                    )
+                    indices[e, p, spec.DELTA_SLOTS : spec.DELTA_SLOTS + 2] = (
+                        spec.DELTA_BASE
+                        + rng.choice(spec.NUM_FEATURES, 2, replace=False)
+                    )
+                    indices[e, p, spec.DELTA_SLOTS + 2 : 2 * spec.DELTA_SLOTS] = (
+                        spec.DELTA_BASE + spec.NUM_FEATURES
+                    )
+                parent[e] = (start << 1) | 1
+        return indices, parent
+
+    out = {}
+    for name, size, make in (
+        ("1024", 1024, full_workload),
+        ("16384", 16384, full_workload),
+        ("blocks_16384", 16384, block_workload),
+    ):
+        indices, parent = make(size)
         buckets = rng.integers(0, 8, size, dtype=np.int32)
         d_idx = jax.device_put(jnp.asarray(indices))
         d_buckets = jax.device_put(jnp.asarray(buckets))
+        d_parent = jax.device_put(jnp.asarray(parent))
 
         # Difference two loop lengths to cancel the per-dispatch round
         # trip. The spread must dominate transport JITTER too (tunnel
@@ -124,11 +159,11 @@ def bench_device_evaluator() -> dict:
         # int(...) materializes the scalar on the host — the only reliable
         # completion barrier here (block_until_ready returns early through
         # the remote-device tunnel).
-        int(eval_loop(params, d_idx, d_buckets, r1))  # compile + warm
+        int(eval_loop(params, d_idx, d_buckets, d_parent, r1))  # compile+warm
 
         def timed(rounds: int) -> float:
             t0 = time.perf_counter()
-            int(eval_loop(params, d_idx, d_buckets, rounds))
+            int(eval_loop(params, d_idx, d_buckets, d_parent, rounds))
             return time.perf_counter() - t0
 
         t_small = sorted(timed(r1) for _ in range(3))[1]
@@ -137,11 +172,11 @@ def bench_device_evaluator() -> dict:
         if per_eval_s <= 0:
             # Jitter swallowed the compute entirely; report the bound we
             # can still stand behind instead of a fabricated rate.
-            out[f"evals_per_s_{size}"] = None
-            out[f"device_ms_per_batch_{size}"] = None
+            out[f"evals_per_s_{name}"] = None
+            out[f"device_ms_per_batch_{name}"] = None
         else:
-            out[f"evals_per_s_{size}"] = round(size / per_eval_s)
-            out[f"device_ms_per_batch_{size}"] = round(per_eval_s * 1e3, 3)
+            out[f"evals_per_s_{name}"] = round(size / per_eval_s)
+            out[f"device_ms_per_batch_{name}"] = round(per_eval_s * 1e3, 3)
     return out
 
 

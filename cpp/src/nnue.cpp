@@ -59,9 +59,15 @@ std::string NnueNet::load(const std::string& path) {
       return "truncated l1 weight";
     if (!f.read(reinterpret_cast<char*>(&l2_bias[b * NNUE_L3]), NNUE_L3 * 4))
       return "truncated l2 bias";
-    if (!f.read(reinterpret_cast<char*>(&l2_weight[size_t(b) * NNUE_L3 * 2 * NNUE_L2]),
-                NNUE_L3 * 2 * NNUE_L2))
-      return "truncated l2 weight";
+    // l2 rows are serialized over inputs padded to 32 (SF convention);
+    // drop the zero pad columns while reading.
+    for (int r = 0; r < NNUE_L3; r++) {
+      char padded[32];
+      static_assert(2 * NNUE_L2 <= 32, "l2 padded width");
+      if (!f.read(padded, 32)) return "truncated l2 weight";
+      memcpy(&l2_weight[size_t(b) * NNUE_L3 * 2 * NNUE_L2 + size_t(r) * 2 * NNUE_L2],
+             padded, 2 * NNUE_L2);
+    }
     if (!f.read(reinterpret_cast<char*>(&out_bias[b]), 4)) return "truncated out bias";
     if (!f.read(reinterpret_cast<char*>(&out_weight[b * NNUE_L3]), NNUE_L3))
       return "truncated out weight";
@@ -72,23 +78,11 @@ std::string NnueNet::load(const std::string& path) {
 template <typename T>
 int nnue_features(const Position& pos, Color perspective, T* out) {
   Square ksq = pos.king_sq(perspective);
-  int flip = perspective == BLACK ? 56 : 0;
-  int k0 = ksq ^ flip;
-  int mirror = file_of(k0) >= 4 ? 7 : 0;
-  int okq = k0 ^ mirror;
-  int bucket = rank_of(okq) * 4 + file_of(okq);
-  int base = bucket * (NNUE_PLANES * 64);
-
   int n = 0;
   Bitboard occ = pos.occupied();
   while (occ) {
     Square s = pop_lsb(occ);
-    int pc = pos.piece_on(s);
-    PieceType t = piece_type(pc);
-    Color c = piece_color(pc);
-    int plane = t == KING ? 10 : 2 * int(t) + (c != perspective ? 1 : 0);
-    int osq = s ^ flip ^ mirror;
-    out[n++] = T(base + plane * 64 + osq);
+    out[n++] = T(nnue_feature_index(ksq, perspective, pos.piece_on(s), s));
   }
   return n;
 }

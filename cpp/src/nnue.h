@@ -17,6 +17,16 @@ constexpr int NNUE_PLANES = 11;
 constexpr int NNUE_KING_BUCKETS = 32;
 constexpr int NNUE_FEATURES = NNUE_KING_BUCKETS * NNUE_PLANES * 64;  // 22528
 constexpr int NNUE_MAX_ACTIVE = 32;
+// Incremental (delta) eval wire constants — MUST match
+// fishnet_tpu/nnue/spec.py (DELTA_BASE / DELTA_SLOTS). A removed
+// feature is shipped as DELTA_BASE + index (still uint16); the
+// evaluators decode by subtraction and SUBTRACT that row — the device
+// table stays single-copy. Per perspective of a delta entry: added
+// features in slots [0, DELTA_SLOTS), removals in
+// [DELTA_SLOTS, 2*DELTA_SLOTS), each region padded with its own
+// sentinel (FEATURES, resp. DELTA_BASE + FEATURES).
+constexpr int NNUE_DELTA_BASE = NNUE_FEATURES + 1;
+constexpr int NNUE_DELTA_SLOTS = 4;
 constexpr int NNUE_L1 = 1024;
 constexpr int NNUE_L1_HALF = NNUE_L1 / 2;
 constexpr int NNUE_PSQT_BUCKETS = 8;
@@ -38,6 +48,22 @@ struct NnueNet {
   // Returns empty string on success.
   std::string load(const std::string& path);
 };
+
+// HalfKAv2_hm feature index of one piece for one perspective, given that
+// perspective's king square. Factored out so incremental (delta) updates
+// can index single added/removed pieces.
+inline int nnue_feature_index(Square ksq, Color perspective, int piece,
+                              Square s) {
+  int flip = perspective == BLACK ? 56 : 0;
+  int k0 = ksq ^ flip;
+  int mirror = file_of(k0) >= 4 ? 7 : 0;
+  int okq = k0 ^ mirror;
+  int bucket = rank_of(okq) * 4 + file_of(okq);
+  PieceType t = piece_type(piece);
+  Color c = piece_color(piece);
+  int plane = t == KING ? 10 : 2 * int(t) + (c != perspective ? 1 : 0);
+  return bucket * (NNUE_PLANES * 64) + plane * 64 + (s ^ flip ^ mirror);
+}
 
 // HalfKAv2_hm active features for one perspective. Writes feature indices
 // to out (capacity NNUE_MAX_ACTIVE); returns the count. Templated over

@@ -81,13 +81,87 @@ struct Slot {
   // atomic, relaxed ordering suffices (it's a latch, not a handoff).
   std::atomic<bool> stop_requested{false};
   // Eval request state (valid while wants_eval): a block of 1..EVAL_BLOCK_MAX.
-  // Features are stored as uint16 (indices < 22528): half the memory per
-  // slot and the emission into the device batch is a straight memcpy.
+  // Features are stored as uint16 (delta indices reach 2*22528+1, still
+  // uint16): half the memory per slot and the emission into the device
+  // batch is a straight memcpy.
   int block_n = 0;
   uint16_t features[EVAL_BLOCK_MAX][2][NNUE_MAX_ACTIVE];
   int32_t buckets[EVAL_BLOCK_MAX];
+  // Incremental-eval reference, block-relative: -1 = standalone full
+  // feature set; else (ref_entry << 1) | persp_swap, meaning this
+  // entry's features are DELTAS against that (always-full) entry's
+  // accumulator, with the two perspectives swapped when the sides to
+  // move differ. Rebased to batch-relative indices at emission.
+  int32_t parent_code[EVAL_BLOCK_MAX];
   int32_t eval_values[EVAL_BLOCK_MAX];
 };
+
+namespace {
+
+// Full feature extraction for block entry j.
+void fill_full(Slot* slot, int j, const Position& pos) {
+  for (int p = 0; p < 2; p++) {
+    int cnt = nnue_features(pos, p == 0 ? pos.stm : ~pos.stm,
+                            slot->features[j][p]);
+    for (int i = cnt; i < NNUE_MAX_ACTIVE; i++)
+      slot->features[j][p][i] = uint16_t(NNUE_FEATURES);
+  }
+  slot->parent_code[j] = -1;
+}
+
+// Incremental feature extraction: entry j's accumulator = ref's
+// accumulator (perspectives swapped if the side to move differs) plus
+// the added-piece rows minus the removed-piece rows. Wire contract
+// (fishnet_tpu/nnue/spec.py DELTA_SLOTS, ops/ft_gather.py sparse mode):
+// per perspective, adds in slots [0, DELTA_SLOTS) padded with the
+// sentinel, removals in [DELTA_SLOTS, 2*DELTA_SLOTS) encoded as
+// NNUE_DELTA_BASE + index and padded with NNUE_DELTA_BASE + sentinel
+// (which decodes back to the zero row); the rest plain sentinel. Only
+// valid while each perspective's king is on the same square in both
+// positions — a moved king re-bases every feature of that perspective
+// (HalfKA king buckets + mirroring), so such entries fall back to a
+// full fill. Typical delta: 1-3 rows per region vs ~30 for a full fill
+// — a ~4x cut in row DMAs for the prefetch-block children that
+// dominate batch traffic (one move touches at most 2 adds / 3 removes:
+// mover or promotion to-piece, plus from-square, victim, e.p. pawn).
+bool fill_delta(Slot* slot, int j, const Position& ref, const Position& pos,
+                int ref_entry) {
+  constexpr int DELTA_SLOTS = NNUE_DELTA_SLOTS;
+  bool swap = pos.stm != ref.stm;
+  for (int p = 0; p < 2; p++) {
+    Color c = p == 0 ? pos.stm : ~pos.stm;
+    if (ref.king_sq(c) != pos.king_sq(c)) return false;
+    Square ksq = pos.king_sq(c);
+    uint16_t adds[DELTA_SLOTS], rems[DELTA_SLOTS];
+    int n_add = 0, n_rem = 0;
+    for (int s = 0; s < 64; s++) {
+      int before = ref.piece_on(Square(s));
+      int after = pos.piece_on(Square(s));
+      if (before == after) continue;
+      if (before != NO_PIECE) {
+        if (n_rem >= DELTA_SLOTS) return false;
+        rems[n_rem++] =
+            uint16_t(nnue_feature_index(ksq, c, before, Square(s)));
+      }
+      if (after != NO_PIECE) {
+        if (n_add >= DELTA_SLOTS) return false;
+        adds[n_add++] = uint16_t(nnue_feature_index(ksq, c, after, Square(s)));
+      }
+    }
+    uint16_t* row = slot->features[j][p];
+    for (int i = 0; i < DELTA_SLOTS; i++)
+      row[i] = i < n_add ? adds[i] : uint16_t(NNUE_FEATURES);
+    for (int i = 0; i < DELTA_SLOTS; i++)
+      row[DELTA_SLOTS + i] = uint16_t(
+          NNUE_DELTA_BASE + (i < n_rem ? rems[i] : uint16_t(NNUE_FEATURES)));
+    for (int i = 2 * DELTA_SLOTS; i < NNUE_MAX_ACTIVE; i++)
+      row[i] = uint16_t(NNUE_FEATURES);
+  }
+  slot->parent_code[j] = (ref_entry << 1) | (swap ? 1 : 0);
+  return true;
+}
+
+}  // namespace
 
 void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out) {
   // Honor the base-class contract for any n: one suspension per chunk of
@@ -96,12 +170,11 @@ void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out)
     int chunk = std::min(n - base, EVAL_BLOCK_MAX);
     for (int j = 0; j < chunk; j++) {
       const Position& pos = positions[base + j];
-      for (int p = 0; p < 2; p++) {
-        int cnt = nnue_features(pos, p == 0 ? pos.stm : ~pos.stm,
-                                slot_->features[j][p]);
-        for (int i = cnt; i < NNUE_MAX_ACTIVE; i++)
-          slot_->features[j][p][i] = uint16_t(NNUE_FEATURES);
-      }
+      // Entry 0 anchors the chunk with a full feature set; later entries
+      // are close relatives (the prefetcher ships a node with its
+      // children, or sibling evasions) and usually go out as deltas.
+      if (j == 0 || !fill_delta(slot_, j, positions[base], pos, 0))
+        fill_full(slot_, j, pos);
       slot_->buckets[j] = nnue_psqt_bucket(pos);
     }
     slot_->block_n = chunk;
@@ -279,7 +352,7 @@ namespace {
 // the host->device link, which is a scarce resource.
 bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
                 int i, uint16_t* out_features, int32_t* out_buckets,
-                int32_t* out_slots, int capacity) {
+                int32_t* out_slots, int32_t* out_parent, int capacity) {
   Slot& slot = *pool->slots[i];
   int base = int(batch.size());
   if (base + slot.block_n > capacity) return false;  // wait for next step
@@ -291,6 +364,12 @@ bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
            &slot.features[j][0][0], sizeof(uint16_t) * 2 * NNUE_MAX_ACTIVE);
     out_buckets[idx] = slot.buckets[j];
     out_slots[idx] = i;
+    // Rebase delta references from block entries to batch positions
+    // (the whole block ships in this batch, so the reference resolves
+    // within the same device call).
+    int32_t code = slot.parent_code[j];
+    out_parent[idx] =
+        code < 0 ? -1 : int32_t(((base + (code >> 1)) << 1) | (code & 1));
     batch.emplace_back(i, j);
   }
   return true;
@@ -299,7 +378,8 @@ bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
 }  // namespace
 
 int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
-                 int32_t* out_buckets, int32_t* out_slots, int capacity) {
+                 int32_t* out_buckets, int32_t* out_slots,
+                 int32_t* out_parent, int capacity) {
   if (group < 0 || group >= pool->n_groups) group = 0;
   auto& batch = pool->group_batch[group];
   batch.clear();
@@ -317,7 +397,7 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
     Slot& slot = *pool->slots[i];
     if (!slot.active || slot.finished || !slot.wants_eval) continue;
     if (!emit_block(pool, batch, int(i), out_features, out_buckets, out_slots,
-                    capacity))
+                    out_parent, capacity))
       overflow = true;
   }
 
@@ -356,7 +436,7 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
       // Blocks that don't fit stay suspended; phase 1 of the next step
       // picks them up first.
       if (!emit_block(pool, batch, int(i), out_features, out_buckets,
-                      out_slots, capacity))
+                      out_slots, out_parent, capacity))
         overflow = true;
     }
   }

@@ -23,7 +23,7 @@ produced by the native core's ``fc_pos_features``.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +37,10 @@ Params = Dict[str, jax.Array]
 
 def params_from_weights(weights: NnueWeights) -> Params:
     """Device-ready parameter pytree. The FT tables get a zero sentinel
-    row at index NUM_FEATURES so padded feature slots are no-ops."""
+    row at index NUM_FEATURES so padded feature slots are no-ops.
+    (Removed-feature indices of incremental entries, spec.DELTA_BASE+f,
+    are decoded by subtraction at eval time — the table stays single
+    copy to keep the gather's random-read working set small.)"""
     ft_w = np.vstack([weights.ft_weight, np.zeros((1, spec.L1), np.int16)])
     ft_psqt = np.vstack(
         [weights.ft_psqt, np.zeros((1, spec.NUM_PSQT_BUCKETS), np.int32)]
@@ -61,20 +64,76 @@ def _trunc_div(a: jax.Array, d: int) -> jax.Array:
     return jax.lax.div(a, jnp.int32(d))
 
 
-def evaluate_batch(params: Params, indices: jax.Array, buckets: jax.Array) -> jax.Array:
+def evaluate_batch(
+    params: Params,
+    indices: jax.Array,
+    buckets: jax.Array,
+    parent: Optional[jax.Array] = None,
+) -> jax.Array:
     """Evaluate a batch. indices: integer [B, 2, 32] (stm perspective
     first, padded with NUM_FEATURES) — uint16 on the wire from the native
     pool (half the host->device bytes), any int dtype accepted; buckets:
     int32 [B]. Returns int32 [B] centipawn scores from the side to move's
-    point of view."""
+    point of view.
+
+    ``parent`` (optional, int32 [B]) enables incremental evaluation:
+    -1 marks a standalone full entry; code >= 0 means this entry's
+    indices are DELTAS (removals via spec.DELTA_BASE + i, the negated
+    table half) against batch entry ``code >> 1``'s accumulator, with
+    the perspectives swapped when ``code & 1`` (the sides to move
+    differ). Referenced entries must themselves be full — the native
+    pool guarantees every block's entry 0 is. Exact: integer adds
+    commute, so delta reconstruction is bit-identical to a full gather.
+    """
     indices = indices.astype(jnp.int32)
     # Feature transformer: fused Pallas gather-accumulate on TPU (single
     # HBM pass per row), XLA take+sum elsewhere. [B, 2, L1] int32.
     from fishnet_tpu.ops.ft_gather import ft_accumulate
 
-    acc = ft_accumulate(params["ft_w"], params["ft_b"], indices)
-    psqt_rows = jnp.take(params["ft_psqt"], indices, axis=0)  # [B, 2, 32, 8]
-    psqt = jnp.sum(psqt_rows, axis=2)  # [B, 2, 8] int32
+    if parent is None:
+        # Full entries only: no removal encodings can appear, so skip
+        # the decode arithmetic entirely in this trace.
+        acc = ft_accumulate(params["ft_w"], params["ft_b"], indices)
+        psqt_rows = jnp.take(params["ft_psqt"], indices, axis=0)
+        psqt = jnp.sum(psqt_rows, axis=2)  # [B, 2, 8] int32
+    else:
+        acc = ft_accumulate(
+            params["ft_w"],
+            params["ft_b"],
+            indices,
+            delta_base=spec.DELTA_BASE,
+            sparse=parent >= 0,
+        )
+        # PSQT accumulators, honoring removal encodings (DELTA_BASE + f
+        # subtracts feature f's row; its pad decodes to the sentinel).
+        is_rem = indices >= spec.DELTA_BASE
+        base_idx = jnp.where(is_rem, indices - spec.DELTA_BASE, indices)
+        sign = jnp.where(is_rem, -1, 1)
+        psqt_rows = jnp.take(params["ft_psqt"], base_idx, axis=0)
+        psqt = jnp.sum(psqt_rows * sign[..., None], axis=2)  # [B, 2, 8]
+
+    if parent is not None:
+        parent = parent.astype(jnp.int32)
+        valid = parent >= 0
+        ref = jnp.where(valid, parent >> 1, 0)
+        swap = (parent & 1).astype(bool)
+        # Gather the referenced (full) accumulators; swap perspectives
+        # where the child's side to move flipped relative to its parent.
+        perm = jnp.where(
+            swap[:, None], jnp.array([1, 0]), jnp.array([0, 1])
+        )  # [B, 2]
+        ref_acc = jnp.take_along_axis(
+            jnp.take(acc, ref, axis=0), perm[:, :, None], axis=1
+        )
+        ref_psqt = jnp.take_along_axis(
+            jnp.take(psqt, ref, axis=0), perm[:, :, None], axis=1
+        )
+        # The delta entry's own partial already includes the bias once;
+        # subtract the copy that rides in with the parent accumulator.
+        acc = jnp.where(
+            valid[:, None, None], acc + ref_acc - params["ft_b"], acc
+        )
+        psqt = jnp.where(valid[:, None, None], psqt + ref_psqt, psqt)
 
     # Clipped pairwise multiply; stm half first.
     c = jnp.clip(acc, 0, spec.FT_CLIP)
@@ -134,4 +193,6 @@ def evaluate_batch(params: Params, indices: jax.Array, buckets: jax.Array) -> ja
     return _trunc_div(positional + material, spec.FV_SCALE)
 
 
+#: jit of evaluate_batch; ``parent=None`` (full entries only) and
+#: ``parent=array`` (may carry incremental entries) trace separately.
 evaluate_batch_jit = jax.jit(evaluate_batch)

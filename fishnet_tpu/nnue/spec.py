@@ -43,11 +43,27 @@ Network (int quantization in brackets):
     all `>>` are arithmetic (flooring) shifts. The JAX evaluator
     reproduces exactly these semantics.
 
-Divergence note vs. real SF15 nets: the arithmetic above follows the
-published SFNNv5 operator set (SqrClippedReLU >> 19, ClippedReLU >> 6,
-pairwise >> 7, FV_SCALE 16); exact parity with stock Stockfish on its
-shipped net cannot be validated offline, so the authoritative contract
-is C++ == JAX on any weights this framework loads or trains.
+Divergence note vs. real SF15 nets — what IS and IS NOT verified
+offline (this environment has no network egress and no pretrained net):
+
+  Verified offline:
+  * Field order, dtypes, and padded widths of the serialization match
+    the documented SF/nnue-pytorch layout, via an independent bytewise
+    golden-vector fixture (tests/test_nnue.py
+    test_nnue_golden_byte_layout), including the 30->32 padded l2 rows.
+  * The arithmetic follows the published SFNNv5 operator set
+    (SqrClippedReLU >> 19, ClippedReLU >> 6, pairwise >> 7, FV_SCALE
+    16), and the C++ scalar and JAX batched evaluators agree bit for
+    bit on random nets and positions — including incremental (delta)
+    entries and search-level results at fixed depth.
+
+  NOT verifiable offline (would need a real nn-*.nnue file):
+  * The section-hash constants (FT 0x5D69D5B8, stack 0x63337156): the
+    loader deliberately skips them rather than verifying.
+  * That FILE_VERSION/ARCH_HASH match the bytes of the shipped SF15
+    net, and end-to-end score parity against stock Stockfish on it.
+  The authoritative offline contract is therefore C++ == JAX on any
+  weights this framework loads or trains.
 """
 
 from __future__ import annotations
@@ -59,6 +75,17 @@ NUM_KING_BUCKETS = 32
 FEATURES_PER_BUCKET = NUM_PLANES * NUM_SQ  # 704
 NUM_FEATURES = NUM_KING_BUCKETS * FEATURES_PER_BUCKET  # 22528
 MAX_ACTIVE_FEATURES = 32
+#: Incremental (delta) entries encode "remove feature f" as the index
+#: DELTA_BASE + f (still uint16; cpp/src/nnue.h NNUE_DELTA_BASE). The
+#: evaluators decode by subtraction and SUBTRACT those rows — the table
+#: itself stays single-copy (a negated-copy table was tried and cost
+#: ~25% extra gather time from the doubled random-read working set).
+#: Wire contract per perspective of a delta entry: added features in
+#: slots [0, DELTA_SLOTS), removals in [DELTA_SLOTS, 2*DELTA_SLOTS),
+#: each region padded with its own sentinel (NUM_FEATURES, resp.
+#: DELTA_BASE + NUM_FEATURES); slots beyond are plain sentinel.
+DELTA_BASE = NUM_FEATURES + 1
+DELTA_SLOTS = 4
 
 L1 = 1024  # feature-transformer width
 L1_HALF = L1 // 2  # pairwise-multiplied halves
@@ -78,6 +105,11 @@ SKIP_DEN = 127 * (1 << WEIGHT_SCALE_BITS)
 # Serialization (little-endian), nnue-pytorch/SF compatible framing
 FILE_VERSION = 0x7AF32F20
 ARCH_HASH = 0x3E5AA6EE  # HalfKAv2_hm + SFNNv5 stack (public constant)
+#: SF's AffineTransform serializes weights over inputs PADDED to a
+#: multiple of 32 (SIMD register width): the 30-wide l2 layer occupies
+#: 32 int8 per output row on disk, the two pad columns zero. l1 (1024)
+#: and out (32) are already aligned.
+L2_PADDED_INPUTS = 32
 ARCH_DESCRIPTION = (
     b"Features=HalfKAv2_hm(Friend)[22528->1024x2],"
     b"Network=AffineTransform[1->32](ClippedReLU[32](AffineTransform[32->30]"

@@ -84,13 +84,17 @@ class NnueWeights:
         f.write(self.ft_bias.astype("<i2").tobytes())
         f.write(self.ft_weight.astype("<i2").tobytes())
         f.write(self.ft_psqt.astype("<i4").tobytes())
-        # Layer stacks, bucket-major.
+        # Layer stacks, bucket-major. The l2 rows are padded to
+        # spec.L2_PADDED_INPUTS on disk (SF serializes affine inputs
+        # rounded up to 32; the pad columns are zero).
         for b in range(spec.NUM_PSQT_BUCKETS):
             f.write(struct.pack("<I", 0x63337156))
             f.write(self.l1_bias[b].astype("<i4").tobytes())
             f.write(self.l1_weight[b].astype("<i1").tobytes())
             f.write(self.l2_bias[b].astype("<i4").tobytes())
-            f.write(self.l2_weight[b].astype("<i1").tobytes())
+            l2 = np.zeros((spec.L3, spec.L2_PADDED_INPUTS), np.int8)
+            l2[:, : 2 * spec.L2] = self.l2_weight[b]
+            f.write(l2.astype("<i1").tobytes())
             f.write(self.out_bias[b].astype("<i4").tobytes())
             f.write(self.out_weight[b].astype("<i1").tobytes())
 
@@ -140,7 +144,9 @@ class NnueWeights:
             l1_b[b] = arr("<i4", (spec.L2 + 1,))
             l1_w[b] = arr("<i1", (spec.L2 + 1, spec.L1))
             l2_b[b] = arr("<i4", (spec.L3,))
-            l2_w[b] = arr("<i1", (spec.L3, 2 * spec.L2))
+            # On disk the l2 rows span the PADDED input width; the pad
+            # columns carry no weights.
+            l2_w[b] = arr("<i1", (spec.L3, spec.L2_PADDED_INPUTS))[:, : 2 * spec.L2]
             o_b[b] = arr("<i4", (1,))
             o_w[b] = arr("<i1", (1, spec.L3))
 

@@ -22,65 +22,138 @@ this kernel in interpreter mode against it.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fishnet_tpu.nnue.spec import DELTA_SLOTS as _DELTA_SLOTS
+
 __all__ = ["ft_accumulate"]
 
 
-def _xla_ft_accumulate(ft_w: jax.Array, ft_b: jax.Array, indices: jax.Array) -> jax.Array:
-    rows = jnp.take(ft_w, indices, axis=0)  # [B, 2, A, L1] int16
-    return ft_b.astype(jnp.int32) + jnp.sum(rows.astype(jnp.int32), axis=2)
+def _xla_ft_accumulate(
+    ft_w: jax.Array,
+    ft_b: jax.Array,
+    indices: jax.Array,
+    delta_base: int | None = None,
+) -> jax.Array:
+    if delta_base is not None:
+        # Removal encodings (delta_base + f) subtract row f; their pads
+        # decode to the zero sentinel, so the sign is irrelevant there.
+        is_rem = indices >= delta_base
+        indices = jnp.where(is_rem, indices - delta_base, indices)
+        sign = jnp.where(is_rem, -1, 1)
+    rows = jnp.take(ft_w, indices, axis=0).astype(jnp.int32)  # [B, 2, A, L1]
+    if delta_base is not None:
+        rows = rows * sign[..., None]
+    return ft_b.astype(jnp.int32) + jnp.sum(rows, axis=2)
 
 
-def _kernel(idx_ref, ft_ref, bias_ref, out_ref, rows, sems):
+#: Slot budget of the SPARSE mode, per perspective: incremental (delta)
+#: entries carry up to DELTA_SLOTS added rows in slots [0, DELTA_SLOTS)
+#: and up to DELTA_SLOTS removed rows (encoded delta_base + f) in slots
+#: [DELTA_SLOTS, 2*DELTA_SLOTS), each region padded with its own
+#: sentinel. The kernel fetches exactly these 2*DELTA_SLOTS slots,
+#: pads included (sentinel rows are zero, so sums stay exact), and
+#: reduces adds minus removes. Both modes are branch-free per row —
+#: per-row control flow (predicates or dynamic loops) was measured to
+#: cost MORE than the padded DMAs it avoids; a 4x shorter unrolled loop
+#: is what cashes in the gather's ~12 ns/row DMA-count bound.
+#: The slot count _DELTA_SLOTS (imported above) is the WIRE contract
+#: shared with the native pool (spec.DELTA_SLOTS == cpp/src/nnue.h
+#: NNUE_DELTA_SLOTS).
+_SPARSE_SLOTS = 2 * _DELTA_SLOTS
+
+
+def _kernel(idx_ref, sparse_ref, ft_ref, bias_ref, out_ref, rows, sems, *,
+            delta_base):
     # Software-pipelined gather: scratch holds TWO positions' rows. Grid
     # step b waits on the buffer its predecessor filled for it, issues
     # position b+1's row DMAs into the other buffer, then reduces — so
-    # ~2x MAX_ACTIVE row copies are in flight at all times and the HBM
-    # pipe never drains between positions. Row addresses come from the
-    # scalar-prefetched index operand, available before the body runs.
+    # row copies stay in flight at all times and the HBM pipe never
+    # drains between positions. Row addresses come from the scalar-
+    # prefetched index operand, available before the body runs.
+    #
+    # Per-position mode, a pure function of the scalar-prefetched sparse
+    # flags (so the issuing step for b+1 and the waiting step at b+1
+    # always agree): sparse (incremental/delta) entries touch only
+    # _SPARSE_SLOTS slots per perspective — removal slots' indices are
+    # decoded by subtracting delta_base — while dense entries fetch all
+    # slots as plain additions.
     b = pl.program_id(0)
     n = pl.num_programs(0)
     n_active = rows.shape[1] // 2  # both perspectives share a buffer
 
-    def issue(pos, slot):
+    def transfer(pos, slot, start, limit, is_sparse):
         # Each feature row is one native (sub, 128) int16 tile, so
-        # single-row HBM slices stay tile-aligned. Padded index slots
-        # point at the sentinel zero row: no branches needed.
+        # single-row HBM slices stay tile-aligned.
         for p in range(2):
-            for k in range(n_active):
-                pltpu.make_async_copy(
-                    ft_ref.at[idx_ref[pos, p, k]],
-                    rows.at[slot, p * n_active + k],
-                    sems.at[slot, p * n_active + k],
-                ).start()
+            for k in range(limit):
+                idx = idx_ref[pos, p, k]
+                if is_sparse and k >= _DELTA_SLOTS:
+                    idx = idx - delta_base  # removal slot: decode
+                i = p * n_active + k
+                dma = pltpu.make_async_copy(
+                    ft_ref.at[idx], rows.at[slot, i], sems.at[slot, i],
+                )
+                dma.start() if start else dma.wait()
+
+    def both_modes(pos, fn):
+        # fn(limit, is_sparse); the flag is explicit rather than inferred
+        # from the limit so a dense n_active equal to _SPARSE_SLOTS could
+        # never alias into removal decoding.
+        if delta_base is None:
+            fn(n_active, False)
+            return
+        sparse = sparse_ref[pos] != 0
+
+        @pl.when(sparse)
+        def _():
+            fn(_SPARSE_SLOTS, True)
+
+        @pl.when(jnp.logical_not(sparse))
+        def _():
+            fn(n_active, False)
 
     slot = jax.lax.rem(b, 2)
 
     @pl.when(b == 0)
     def _():
-        issue(0, 0)
+        both_modes(0, lambda lim, sp: transfer(0, 0, True, lim, sp))
 
     @pl.when(b + 1 < n)
     def _():
-        issue(b + 1, jax.lax.rem(b + 1, 2))
+        nxt = jax.lax.rem(b + 1, 2)
+        both_modes(b + 1, lambda lim, sp: transfer(b + 1, nxt, True, lim, sp))
 
-    for p in range(2):
-        for k in range(n_active):
-            pltpu.make_async_copy(
-                ft_ref.at[idx_ref[b, p, k]],
-                rows.at[slot, p * n_active + k],
-                sems.at[slot, p * n_active + k],
-            ).wait()
+    both_modes(b, lambda lim, sp: transfer(b, slot, False, lim, sp))
 
     bias = bias_ref[:].astype(jnp.int32)
-    all_rows = rows[slot].astype(jnp.int32)  # [2A, sub, 128]
-    out_ref[0, 0] = bias + jnp.sum(all_rows[:n_active], axis=0)
-    out_ref[0, 1] = bias + jnp.sum(all_rows[n_active:], axis=0)
+
+    def reduce(limit, is_sparse):
+        # jnp.sum (tree reduction), not a serial add chain.
+        for p in range(2):
+            base = p * n_active
+            if is_sparse:
+                adds = jnp.sum(
+                    rows[slot, base : base + _DELTA_SLOTS].astype(jnp.int32),
+                    axis=0,
+                )
+                rems = jnp.sum(
+                    rows[slot, base + _DELTA_SLOTS : base + _SPARSE_SLOTS]
+                    .astype(jnp.int32),
+                    axis=0,
+                )
+                out_ref[0, p] = bias + adds - rems
+            else:
+                out_ref[0, p] = bias + jnp.sum(
+                    rows[slot, base : base + limit].astype(jnp.int32), axis=0
+                )
+
+    both_modes(b, reduce)
 
 
 # Positions per pallas_call: the scalar-prefetch index operand lives in
@@ -92,9 +165,14 @@ def _kernel(idx_ref, ft_ref, bias_ref, out_ref, rows, sems):
 _CHUNK = 512
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "delta_base"))
 def _pallas_ft_accumulate(
-    ft_w: jax.Array, ft_b: jax.Array, indices: jax.Array, interpret: bool = False
+    ft_w: jax.Array,
+    ft_b: jax.Array,
+    indices: jax.Array,
+    sparse: Optional[jax.Array] = None,
+    interpret: bool = False,
+    delta_base: int | None = None,
 ) -> jax.Array:
     batch, persp, n_active = indices.shape
     l1 = ft_w.shape[1]
@@ -107,17 +185,17 @@ def _pallas_ft_accumulate(
     ft_tiles = ft_w.reshape(ft_w.shape[0], sub, 128)
     bias_tile = ft_b.reshape(sub, 128)
 
-    def run_chunk(idx_chunk: jax.Array) -> jax.Array:
+    def run_chunk(idx_chunk: jax.Array, sparse_chunk: jax.Array) -> jax.Array:
         chunk = idx_chunk.shape[0]
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,  # indices + per-position sparse flags
             grid=(chunk,),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.ANY),  # ft_w stays in HBM
                 pl.BlockSpec(memory_space=pltpu.VMEM),  # bias
             ],
             out_specs=pl.BlockSpec(
-                (1, 2, sub, 128), lambda b, idx_ref: (b, 0, 0, 0)
+                (1, 2, sub, 128), lambda b, idx_ref, sparse_ref: (b, 0, 0, 0)
             ),
             scratch_shapes=[
                 pltpu.VMEM((2, 2 * n_active, sub, 128), ft_w.dtype),
@@ -125,15 +203,20 @@ def _pallas_ft_accumulate(
             ],
         )
         return pl.pallas_call(
-            _kernel,
+            functools.partial(_kernel, delta_base=delta_base),
             out_shape=jax.ShapeDtypeStruct((chunk, 2, sub, 128), jnp.int32),
             grid_spec=grid_spec,
             interpret=interpret,
-        )(idx_chunk, ft_tiles, bias_tile)
+        )(idx_chunk, sparse_chunk, ft_tiles, bias_tile)
 
     idx = indices.astype(jnp.int32)
+    flags = (
+        jnp.zeros((batch,), jnp.int32)
+        if sparse is None
+        else sparse.astype(jnp.int32)
+    )
     outs = [
-        run_chunk(idx[start : start + _CHUNK])
+        run_chunk(idx[start : start + _CHUNK], flags[start : start + _CHUNK])
         for start in range(0, batch, _CHUNK)
     ]
     out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
@@ -147,13 +230,21 @@ def ft_accumulate(
     *,
     use_pallas: bool | None = None,
     interpret: bool = False,
+    delta_base: int | None = None,
+    sparse: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Feature-transformer accumulators, bias included: int32 [B, 2, L1].
 
-    ``ft_w`` [N+1, L1] int16 with a zero sentinel row at index N;
+    ``ft_w`` [rows, L1] int16 whose LAST row is the zero sentinel;
     ``ft_b`` [L1] int16; ``indices`` integer [B, 2, MAX_ACTIVE] padded
-    with N. ``use_pallas=None`` auto-selects: the fused kernel on TPU
-    backends when shapes conform (lane-aligned L1), XLA otherwise.
+    with the sentinel index. With ``delta_base`` set, rows flagged by
+    ``sparse`` (bool [B]) are incremental (delta) entries following the
+    spec.DELTA_SLOTS wire contract: adds in the first slots, removals
+    (encoded delta_base + f) after them — the fused kernel fetches only
+    those few slots and subtracts the removal rows, which is where
+    incremental eval's DMA savings land. ``use_pallas=None``
+    auto-selects: the fused kernel on TPU backends when shapes conform
+    (lane-aligned L1), XLA otherwise.
     """
     indices = indices.astype(jnp.int32)
     if use_pallas is None:
@@ -161,5 +252,8 @@ def ft_accumulate(
             jax.default_backend() == "tpu" and ft_w.shape[1] % 1024 == 0
         )
     if use_pallas or interpret:
-        return _pallas_ft_accumulate(ft_w, ft_b, indices, interpret=interpret)
-    return _xla_ft_accumulate(ft_w, ft_b, indices)
+        return _pallas_ft_accumulate(
+            ft_w, ft_b, indices, sparse,
+            interpret=interpret, delta_base=delta_base,
+        )
+    return _xla_ft_accumulate(ft_w, ft_b, indices, delta_base=delta_base)

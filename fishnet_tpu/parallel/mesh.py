@@ -104,13 +104,21 @@ class ShardedEvaluator:
         self.batch_capacity = pad_to_multiple(batch_capacity, self.n_devices)
         self.params = jax.device_put(params, replicated(self.mesh))
         in_shard = batch_sharding(self.mesh)
+        # Incremental (delta) entries reference other entries of the
+        # SAME batch; with the batch sharded, that gather crosses shard
+        # boundaries, and GSPMD resolves it (all-gather of the partial
+        # accumulators over ICI) from these annotations alone.
         self._fn = jax.jit(
             evaluate_batch,
-            in_shardings=(replicated(self.mesh), in_shard, in_shard),
+            in_shardings=(replicated(self.mesh), in_shard, in_shard, in_shard),
             out_shardings=replicated(self.mesh),
         )
 
-    def __call__(self, params, indices, buckets):
+    def __call__(self, params, indices, buckets, parent=None):
         # Signature-compatible with evaluate_batch_jit; `params` is
         # ignored — the replicated tree from construction is used.
-        return self._fn(self.params, indices, buckets)
+        if parent is None:
+            import numpy as _np
+
+            parent = _np.full((indices.shape[0],), -1, _np.int32)
+        return self._fn(self.params, indices, buckets, parent)

@@ -77,7 +77,8 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib.fc_pool_step.argtypes = [
         ctypes.c_void_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
-        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
     ]
     lib.fc_pool_step.restype = ctypes.c_int
     lib.fc_pool_provide.argtypes = [
@@ -288,6 +289,9 @@ class SearchService:
         self._feat_buf = np.empty((k, cap, 2, spec.MAX_ACTIVE_FEATURES), dtype=np.uint16)
         self._bucket_buf = np.empty((k, cap), dtype=np.int32)
         self._slot_buf = np.empty((k, cap), dtype=np.int32)
+        # Incremental-eval references (batch-relative parent codes; -1 =
+        # full entry) emitted by the pool alongside the features.
+        self._parent_buf = np.empty((k, cap), dtype=np.int32)
         self._pending: Dict[int, _Pending] = {}
         self._submissions: List[Tuple] = []
         self._stop_requests: List[Tuple[int, _Pending]] = []
@@ -358,7 +362,8 @@ class SearchService:
                     (s, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.uint16
                 )
                 bucks = np.zeros((s,), np.int32)
-                np.asarray(self._eval_fn(self._params, feats, bucks))
+                parents = np.full((s,), -1, np.int32)
+                np.asarray(self._eval_fn(self._params, feats, bucks, parents))
             self._warmed = True
 
     def poke(self) -> None:
@@ -444,9 +449,13 @@ class SearchService:
                 break
         feats = self._feat_buf[group]
         buckets = self._bucket_buf[group]
+        parents = self._parent_buf[group]
         feats[n:size] = spec.NUM_FEATURES
         buckets[n:size] = 0
-        return self._eval_fn(self._params, feats[:size], buckets[:size])
+        parents[n:size] = -1
+        return self._eval_fn(
+            self._params, feats[:size], buckets[:size], parents[:size]
+        )
 
     def _resolve_eval(self, n: int, arr) -> np.ndarray:
         """Block until a dispatched eval is done; contiguous int32 [n]."""
@@ -477,6 +486,10 @@ class SearchService:
         ]
         slot_ptrs = [
             self._slot_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            for g in range(k)
+        ]
+        parent_ptrs = [
+            self._parent_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
             for g in range(k)
         ]
         # In-flight device evals per group: group -> (n, dispatched array).
@@ -563,7 +576,7 @@ class SearchService:
                 # Advance this group's fibers; fill its eval batch.
                 n = lib.fc_pool_step(
                     self._pool, g, feat_ptrs[g], bucket_ptrs[g], slot_ptrs[g],
-                    self._group_capacity,
+                    parent_ptrs[g], self._group_capacity,
                 )
                 stepped += n
                 if n > 0:

@@ -163,7 +163,7 @@ def quantize(params: Params, cfg: NetConfig = NetConfig()) -> NnueWeights:
     weights = NnueWeights(
         ft_weight=rnd(params["ft_w"], 127.0, np.int16, -32768, 32767),
         ft_bias=rnd(params["ft_b"], 127.0, np.int16, -32768, 32767),
-        ft_psqt=rnd(params["ft_psqt"], psqt_scale, np.int32, -(2**31), 2**31 - 1),
+        ft_psqt=rnd(params["ft_psqt"], psqt_scale, np.int32, -(2**31) + 1, 2**31 - 1),
         l1_weight=rnd(params["l1_w"], hid, np.int8, -127, 127),
         l1_bias=rnd(params["l1_b"], hid * 127.0, np.int32, -(2**31), 2**31 - 1),
         l2_weight=rnd(params["l2_w"], hid, np.int8, -127, 127),

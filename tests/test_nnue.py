@@ -118,3 +118,153 @@ def test_truncated_file_rejected(tmp_path, weights):
 
     with pytest.raises(NativeCoreError):
         CppNnue(path)
+
+
+def test_delta_reconstruction_parity(weights):
+    """Incremental (delta) entries must be bit-identical to full entries:
+    an entry encoded as set-differences against a full parent (removals
+    via the negated table half at DELTA_BASE) reconstructs exactly the
+    same accumulator, including the perspective swap after a move."""
+    params = params_from_weights(weights)
+    boards = random_positions(40, seed=77)
+
+    full_idx, buckets, parents = [], [], []
+    expect_rows = []  # rows of the batch to compare against full eval
+    for b in boards:
+        moves = b.legal_moves()
+        if not moves:
+            continue
+        child = b.copy()
+        child.push_uci(random.choice(moves))
+        pf, pb = b.nnue_features()
+        cf, cb = child.nnue_features()
+        base = len(full_idx)
+        full_idx.append(pf)
+        buckets.append(pb)
+        parents.append(-1)
+        # Encode the child as deltas vs the parent, following the wire
+        # contract: adds in slots [0, DELTA_SLOTS), removals (encoded
+        # DELTA_BASE + f) in [DELTA_SLOTS, 2*DELTA_SLOTS), each region
+        # padded with its own sentinel. The move flips the side to move,
+        # so child perspective p maps to parent 1-p.
+        delta = np.full((2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES,
+                        np.int32)
+        ok = True
+        for p in (0, 1):
+            child_set = set(int(x) for x in cf[p] if x != spec.NUM_FEATURES)
+            par_set = set(int(x) for x in pf[1 - p] if x != spec.NUM_FEATURES)
+            adds = sorted(child_set - par_set)
+            removes = sorted(par_set - child_set)
+            if len(adds) > spec.DELTA_SLOTS or len(removes) > spec.DELTA_SLOTS:
+                ok = False  # king moved: full rebuild in production too
+                break
+            delta[p, : len(adds)] = adds
+            rem_row = [spec.DELTA_BASE + f for f in removes] + [
+                spec.DELTA_BASE + spec.NUM_FEATURES
+            ] * (spec.DELTA_SLOTS - len(removes))
+            delta[p, spec.DELTA_SLOTS : 2 * spec.DELTA_SLOTS] = rem_row
+        if not ok:
+            continue
+        full_idx.append(delta)
+        buckets.append(cb)
+        parents.append((base << 1) | 1)  # swap perspectives
+        # The same child as a standalone full entry, for comparison.
+        full_idx.append(cf)
+        buckets.append(cb)
+        parents.append(-1)
+        expect_rows.append((base + 1, base + 2))
+
+    assert expect_rows, "no delta pairs were generated"
+    idx = np.stack(full_idx).astype(np.int32)
+    bks = np.asarray(buckets, np.int32)
+    par = np.asarray(parents, np.int32)
+    scores = np.asarray(evaluate_batch_jit(params, idx, bks, par))
+    for delta_row, full_row in expect_rows:
+        assert scores[delta_row] == scores[full_row], (
+            f"delta row {delta_row} != full row {full_row}: "
+            f"{scores[delta_row]} vs {scores[full_row]}"
+        )
+
+
+def test_nnue_golden_byte_layout(tmp_path):
+    """Golden-vector serialization check, independent of the writer: a
+    .nnue stream is hand-assembled field by field in the documented
+    SF/nnue-pytorch order (header, desc, FT bias/weights/psqt, then 8
+    bucket stacks with l2 rows padded to 32 inputs) with markers at
+    known coordinates. load() must map every marker to the right tensor
+    slot, the C++ scalar core must accept the same file, and save() of
+    the same values must reproduce the byte stream exactly."""
+    import struct
+
+    b = spec.NUM_PSQT_BUCKETS
+    ft_bias = (np.arange(spec.L1) % 7 - 3).astype("<i2")
+    ft_w = np.zeros((spec.NUM_FEATURES, spec.L1), "<i2")
+    ft_w[3, 5] = 11
+    ft_w[22527, 1023] = -9
+    psqt = np.zeros((spec.NUM_FEATURES, b), "<i4")
+    psqt[4, 2] = 1234
+    psqt[0, 0] = -777
+    l1_b = np.zeros((b, spec.L2 + 1), "<i4")
+    l1_b[1, 15] = 4242
+    l1_w = np.zeros((b, spec.L2 + 1, spec.L1), "i1")
+    l1_w[1, 2, 1000] = 17
+    l2_b = np.zeros((b, spec.L3), "<i4")
+    l2_b[7, 31] = -31337
+    l2_w = np.zeros((b, spec.L3, 2 * spec.L2), "i1")
+    l2_w[7, 31, 29] = -5  # LAST real column: catches padded-width bugs
+    o_b = np.zeros((b, 1), "<i4")
+    o_b[3, 0] = 99
+    o_w = np.zeros((b, 1, spec.L3), "i1")
+    o_w[3, 0, 31] = 42
+
+    stream = bytearray()
+    stream += struct.pack("<II", spec.FILE_VERSION, spec.ARCH_HASH)
+    stream += struct.pack("<I", len(spec.ARCH_DESCRIPTION))
+    stream += spec.ARCH_DESCRIPTION
+    stream += struct.pack("<I", 0x5D69D5B8)  # FT section hash
+    stream += ft_bias.tobytes()
+    stream += ft_w.tobytes()
+    stream += psqt.tobytes()
+    for k in range(b):
+        stream += struct.pack("<I", 0x63337156)  # stack hash
+        stream += l1_b[k].tobytes()
+        stream += l1_w[k].tobytes()
+        stream += l2_b[k].tobytes()
+        padded = np.zeros((spec.L3, spec.L2_PADDED_INPUTS), "i1")
+        padded[:, : 2 * spec.L2] = l2_w[k]
+        stream += padded.tobytes()
+        stream += o_b[k].tobytes()
+        stream += o_w[k].tobytes()
+
+    golden = tmp_path / "golden.nnue"
+    golden.write_bytes(bytes(stream))
+
+    w = NnueWeights.load(golden)
+    assert w.ft_bias[1] == -2 and w.ft_bias[6] == 3
+    assert w.ft_weight[3, 5] == 11 and w.ft_weight[22527, 1023] == -9
+    assert w.ft_psqt[4, 2] == 1234 and w.ft_psqt[0, 0] == -777
+    assert w.l1_bias[1, 15] == 4242
+    assert w.l1_weight[1, 2, 1000] == 17
+    assert w.l2_bias[7, 31] == -31337
+    assert w.l2_weight[7, 31, 29] == -5
+    assert w.out_bias[3, 0] == 99 and w.out_weight[3, 0, 31] == 42
+
+    # The writer must reproduce the independent encoding byte for byte.
+    roundtrip = tmp_path / "roundtrip.nnue"
+    w.save(roundtrip)
+    assert roundtrip.read_bytes() == bytes(stream)
+
+    # The native scalar core must accept the same stream and agree with
+    # the JAX evaluator on it (the serialization feeding both tiers).
+    oracle = CppNnue(golden)
+    params = params_from_weights(w)
+    board = Board()
+    idx, bucket = board.nnue_features()
+    jax_score = int(
+        np.asarray(
+            evaluate_batch_jit(
+                params, idx[None].astype(np.int32), np.array([bucket], np.int32)
+            )
+        )[0]
+    )
+    assert oracle.evaluate(board) == jax_score

@@ -73,3 +73,54 @@ def test_pallas_chunking_boundaries(batch):
     ref = np.asarray(_xla_ft_accumulate(ft_w, ft_b, idx))
     got = np.asarray(ft_accumulate(ft_w, ft_b, idx, interpret=True))
     assert np.array_equal(ref, got)
+
+
+def test_pallas_sparse_delta_mode_interpret():
+    """The kernel's SPARSE mode (mode-predicated transfers, removal-slot
+    index decode, adds-minus-removes reduce) must agree with the XLA
+    signed fallback in interpreter mode — the only way to execute this
+    branch offline before it serves real TPU traffic."""
+    from fishnet_tpu.ops.ft_gather import _DELTA_SLOTS
+
+    n_features, l1, active = 512, 1024, 32
+    delta_base = n_features + 1
+    rng = np.random.default_rng(3)
+    ft_w = jnp.asarray(
+        np.vstack(
+            [rng.integers(-200, 200, (n_features, l1)), np.zeros((1, l1))]
+        ).astype(np.int16)
+    )
+    ft_b = jnp.asarray(rng.integers(-100, 100, (l1,)).astype(np.int16))
+
+    batch = 8
+    idx = np.full((batch, 2, active), n_features, np.int32)
+    sparse = np.zeros((batch,), bool)
+    for b in range(batch):
+        if b % 2 == 0:  # dense entry
+            idx[b, :, : active - 3] = rng.integers(
+                0, n_features, (2, active - 3)
+            )
+        else:  # sparse delta entry: adds + encoded removals, region-padded
+            sparse[b] = True
+            for p in range(2):
+                n_add = int(rng.integers(0, _DELTA_SLOTS + 1))
+                n_rem = int(rng.integers(0, _DELTA_SLOTS + 1))
+                idx[b, p, :n_add] = rng.integers(0, n_features, n_add)
+                idx[b, p, _DELTA_SLOTS : _DELTA_SLOTS + n_rem] = (
+                    delta_base + rng.integers(0, n_features, n_rem)
+                )
+                idx[b, p, _DELTA_SLOTS + n_rem : 2 * _DELTA_SLOTS] = (
+                    delta_base + n_features
+                )
+
+    ref = np.asarray(
+        _xla_ft_accumulate(ft_w, ft_b, jnp.asarray(idx), delta_base=delta_base)
+    )
+    got = np.asarray(
+        ft_accumulate(
+            ft_w, ft_b, jnp.asarray(idx),
+            interpret=True, delta_base=delta_base,
+            sparse=jnp.asarray(sparse),
+        )
+    )
+    assert np.array_equal(ref, got)

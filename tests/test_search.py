@@ -181,3 +181,48 @@ async def test_eval_traffic_counters_and_adaptive_budget():
         assert c["prefetch_budget"] < 40
     finally:
         svc.close()
+
+
+async def test_scalar_vs_jax_depth1_score_parity():
+    """Depth-1 searches visit root (PV, no pruning) plus qsearch, where
+    every pruning decision depends only on exact eval values — so the
+    scalar backend and the batched JAX backend (whose blocks ship
+    incremental delta entries through the negated-table path) must agree
+    on the score and best move exactly, position by position."""
+    import random
+
+    from fishnet_tpu.chess import Board
+
+    random.seed(99)
+    fens = []
+    while len(fens) < 24:
+        b = Board()
+        for _ in range(random.randrange(2, 60)):
+            if b.outcome() != 0:
+                break
+            b.push_uci(random.choice(b.legal_moves()))
+        if b.outcome() == 0:
+            fens.append(b.fen())
+
+    weights = NnueWeights.random(seed=21)
+    results = {}
+    for backend in ("scalar", "jax"):
+        svc = SearchService(
+            weights=weights, pool_slots=32, batch_capacity=64,
+            tt_bytes=8 << 20, backend=backend,
+        )
+        try:
+            out = []
+            for fen in fens:
+                r = await svc.search(fen, [], depth=1)
+                line = [l for l in r.lines if l.multipv == 1][-1]
+                out.append((line.value, line.is_mate, r.best_move))
+            results[backend] = out
+        finally:
+            svc.close()
+
+    for i, fen in enumerate(fens):
+        assert results["scalar"][i] == results["jax"][i], (
+            f"backend divergence at {fen}: scalar={results['scalar'][i]} "
+            f"jax={results['jax'][i]}"
+        )
