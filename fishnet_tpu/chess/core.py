@@ -80,7 +80,7 @@ def _candidate_libraries() -> list:
     from fishnet_tpu.chess.cpu import detect
 
     tier = detect().best_tier()
-    tiers = {"v3": ["v3", "v2"], "v2": ["v2"]}.get(tier, [])
+    tiers = {"v3": ["v3", "v2"], "v2": ["v2"], "arm64": ["arm64"]}.get(tier, [])
     for t in tiers:
         path = _CPP_DIR / f"libfishnetcore-{t}.so"
         if path.exists():

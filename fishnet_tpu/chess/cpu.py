@@ -22,6 +22,10 @@ class CpuInfo:
     vendor: str = ""
     family: int = 0
     flags: FrozenSet[str] = field(default_factory=frozenset)
+    #: Machine architecture (platform.machine()): tier selection is
+    #: x86-feature based on x86-64 and a single armv8 tier on aarch64
+    #: (mirroring the reference's armv8 build, build.rs:187-276).
+    arch: str = "x86_64"
 
     @property
     def fast_pext(self) -> bool:
@@ -33,7 +37,10 @@ class CpuInfo:
         return True
 
     def best_tier(self) -> Optional[str]:
-        """'v3' (AVX2+fast BMI2), 'v2' (SSE4.2/POPCNT), or None."""
+        """'v3' (AVX2+fast BMI2), 'v2' (SSE4.2/POPCNT), 'arm64'
+        (aarch64), or None."""
+        if self.arch in ("aarch64", "arm64"):
+            return "arm64"
         if {"avx2", "bmi2"} <= self.flags and self.fast_pext:
             return "v3"
         if {"sse4_2", "popcnt"} <= self.flags:
@@ -62,8 +69,12 @@ def parse_cpuinfo(text: str) -> CpuInfo:
 
 
 def detect(cpuinfo_path: str = "/proc/cpuinfo") -> CpuInfo:
+    import dataclasses
+    import platform
+
+    arch = platform.machine() or "x86_64"
     try:
         text = Path(cpuinfo_path).read_text()
     except OSError:
-        return CpuInfo()
-    return parse_cpuinfo(text)
+        return CpuInfo(arch=arch)
+    return dataclasses.replace(parse_cpuinfo(text), arch=arch)

@@ -1,12 +1,16 @@
-"""Custom TPU ops: Pallas kernels and mesh collectives.
+"""Custom TPU ops: Pallas kernels.
 
 * ``ft_gather`` — fused NNUE feature-transformer gather-accumulate,
-  the evaluator's hot op (Pallas, XLA fallback).
-* ``ring_attention`` — sequence-parallel attention over a mesh axis
-  (shard_map + ppermute ring, flash-style online softmax).
+  the evaluator's hot op (Pallas, XLA fallback), including the sparse
+  mode behind incremental (delta) evaluation.
+
+(A ring-attention op existed through round 1 but was deliberately
+removed: nothing in this workload is transformer-shaped — SURVEY.md §5
+records sequence parallelism as n/a, the "long context" analogue here
+is scaling the eval batch, and a tested-but-unused op is negative
+value. See git history if a game-history model ever motivates it.)
 """
 
 from fishnet_tpu.ops.ft_gather import ft_accumulate
-from fishnet_tpu.ops.ring_attention import reference_attention, ring_attention
 
-__all__ = ["ft_accumulate", "reference_attention", "ring_attention"]
+__all__ = ["ft_accumulate"]

@@ -357,3 +357,85 @@ def test_update_against_local_server(tmp_path, monkeypatch):
 
     asyncio.run(scenario())
     assert marker.exists()
+
+
+def test_update_drain_then_exec_restart(tmp_path, monkeypatch):
+    """The exec-restart path — the part that can brick a deployment
+    (main.rs:412-438 analogue): with a newer version on the index,
+    auto_update must run the update command, then replace the process
+    with THE SAME argv, with the attempt marker set so an update that
+    did not actually change the installed version cannot restart-loop."""
+    import os
+    import sys
+
+    from aiohttp import web
+
+    from fishnet_tpu.utils.logger import Logger
+
+    marker = tmp_path / "updated.txt"
+    index = {"latest": "99.0.0", "command": ["touch", str(marker)]}
+
+    # auto_update() is a blocking wrapper (it owns its own asyncio.run),
+    # so the mock index server must live on a loop that keeps running
+    # meanwhile: a daemon thread.
+    import threading
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def serve_forever():
+        asyncio.set_event_loop(loop)
+
+        async def serve():
+            async def handler(request):
+                return web.json_response(index)
+
+            app = web.Application()
+            app.router.add_get("/index.json", handler)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            state["runner"] = runner
+            state["port"] = site._server.sockets[0].getsockname()[1]
+            started.set()
+
+        loop.run_until_complete(serve())
+        loop.run_forever()
+
+    thread = threading.Thread(target=serve_forever, daemon=True)
+    thread.start()
+    assert started.wait(10)
+    port = state["port"]
+    try:
+        monkeypatch.setenv(
+            update_mod.UPDATE_URL_ENV, f"http://127.0.0.1:{port}/index.json"
+        )
+        monkeypatch.delenv(update_mod._ATTEMPT_ENV, raising=False)
+        monkeypatch.setattr(sys, "argv", ["fishnet-tpu", "--cores", "2"])
+        execs = []
+        monkeypatch.setattr(
+            update_mod.os, "execv", lambda exe, argv: execs.append((exe, argv))
+        )
+
+        status = update_mod.auto_update(Logger())
+        assert status.updated and marker.exists()
+        # Re-exec: same interpreter, module entry, original flags.
+        assert execs == [
+            (sys.executable, [sys.executable, "-m", "fishnet_tpu", "--cores", "2"])
+        ]
+        # Loop guard armed for the restarted process.
+        assert os.environ[update_mod._ATTEMPT_ENV] == "99.0.0"
+
+        # Restarted process, update "succeeded" but version unchanged:
+        # must NOT exec again.
+        execs.clear()
+        status = update_mod.auto_update(Logger())
+        assert status.updated and execs == []
+    finally:
+        asyncio.run_coroutine_threadsafe(
+            state["runner"].cleanup(), loop
+        ).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(10)

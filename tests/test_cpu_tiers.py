@@ -64,6 +64,15 @@ def test_unknown_cpu_gets_none():
     assert CpuInfo().best_tier() is None
 
 
+def test_aarch64_gets_arm64_tier():
+    # aarch64 /proc/cpuinfo has no x86 flags line; the arch field alone
+    # selects the single armv8 tier (reference build.rs:187-276 ships an
+    # armv8 engine build the same way).
+    info = CpuInfo(arch="aarch64")
+    assert info.best_tier() == "arm64"
+    assert CpuInfo(arch="x86_64").best_tier() is None
+
+
 @pytest.mark.slow
 def test_tier_builds_load_and_pass_perft():
     import platform
