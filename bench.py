@@ -18,8 +18,11 @@ Two tiers of measurement, both in the one emitted JSON line:
 
 * ``aggregate_search_nps`` (the headline ``value``) — the end-to-end
   rate through search + batching + transport. Under the development
-  tunnel a single device round-trip costs 40-250 ms, so this number is
-  transport-latency-bound.
+  tunnel this number is transport-bound: measured ~100 ms base RTT
+  plus ~90 ms/MB of payload (the link also compresses, so the
+  sentinel-heavy delta entries that dominate production batches ship
+  ~2x cheaper than dense ones). On locally attached TPUs both terms
+  vanish into the device numbers below.
 * ``device`` — pure evaluator throughput, measured by running R evals
   inside ONE jit dispatch (lax.fori_loop, inputs permuted per iteration
   so XLA cannot hoist the work): rate = batch x ΔR / Δt between two

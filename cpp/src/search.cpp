@@ -405,39 +405,36 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
       return v;
   }
 
-  // Static eval of this node, for the eval-gated prunings below. On the
-  // batched bridge an eval costs a device round-trip, which pruning can
-  // never repay — use it only when the TT already has it (prior
-  // iterations and speculative prefetches populate the cache). The
-  // scalar path evaluates directly: its eval is a few microseconds.
-  int static_eval = TT_EVAL_NONE;
-  if (!in_check) {
-    if (hit && tte->eval != EVAL_NONE) {
-      static_eval = tte->eval;
-      if (counters_) {
-        counters_->bump(counters_->tt_eval_hits);
-        if (tte->prefetched) {
-          counters_->bump(counters_->prefetch_hits);
-          tte->prefetched = 0;
-        }
-      }
-    } else if (!eval_->batched()) {
-      static_eval = evaluate(pos);
-      tt_->store_eval(pos.hash, static_eval);
-    }
+  // Margin eval for the prunings below: the host-side CLASSICAL eval,
+  // not NNUE. Deliberate: an NNUE eval costs a device round-trip on the
+  // batched bridge (pruning could never repay it), and gating pruning
+  // on whichever evals HAPPEN to sit in the TT would make the search
+  // tree depend on the backend and on batch pressure (prefetch budget)
+  // — the scalar-vs-batched parity oracle found exactly that
+  // divergence. hce_evaluate is a sub-microsecond deterministic
+  // function of the position, so both backends prune identically;
+  // every RETURNED score still comes from NNUE (the razor path returns
+  // the qsearch value, reverse futility returns the beta bound).
+  int margin_eval = 0;
+  bool have_margin = false;
+  if (!in_check && !is_pv && ply > 0 && depth <= 6) {
+    // depth <= 6 covers every margin pruning below (RFP 6, futility 3,
+    // razor 2); deeper nodes skip the piece loop entirely.
+    constexpr int LIMIT = VALUE_MATE_IN_MAX - 1;
+    int v = hce_evaluate(pos);
+    margin_eval = v < -LIMIT ? -LIMIT : (v > LIMIT ? LIMIT : v);
+    have_margin = true;
   }
-  bool have_eval = static_eval != TT_EVAL_NONE;
 
   // Reverse futility (static beta) pruning: far enough above beta that a
   // shallow search will not drop back under it.
-  if (!is_pv && !in_check && ply > 0 && depth <= 6 && have_eval &&
-      std::abs(beta) < VALUE_MATE_IN_MAX && static_eval - 80 * depth >= beta)
-    return static_eval;
+  if (have_margin && std::abs(beta) < VALUE_MATE_IN_MAX &&
+      margin_eval - 80 * depth >= beta)
+    return beta;
 
   // Razoring: hopeless at shallow depth — verify with qsearch and trust
   // a confirming fail-low.
-  if (!is_pv && !in_check && ply > 0 && depth <= 2 && have_eval &&
-      static_eval + 240 * depth < alpha) {
+  if (have_margin && depth <= 2 && margin_eval + 240 * depth < alpha) {
     int v = qsearch(pos, alpha - 1, alpha, ply);
     if (stopped_) return 0;
     if (v < alpha) return v;
@@ -499,9 +496,9 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
       // Late move pruning: quiets this deep in the ordered list at
       // shallow depth almost never raise alpha.
       if (depth <= 4 && move_count > 4 + depth * depth) continue;
-      // Futility: static eval so far below alpha that a quiet move
+      // Futility: margin eval so far below alpha that a quiet move
       // cannot recover within the remaining depth.
-      if (depth <= 3 && have_eval && static_eval + 120 * depth + 100 <= alpha)
+      if (depth <= 3 && have_margin && margin_eval + 120 * depth + 100 <= alpha)
         continue;
     }
 
