@@ -7,7 +7,8 @@ RUN apt-get update && apt-get install -y --no-install-recommends g++ make && rm 
 COPY cpp/ cpp/
 # Portable CPU-feature tiers, not -march=native: the build container's
 # CPU is not the deployment CPU. The runtime loader detects the host
-# (fishnet_tpu/chess/cpu.py) and picks v3 (AVX2/fast-PEXT) or v2.
+# (fishnet_tpu/chess/cpu.py) and picks v4 (AVX-512), v3
+# (AVX2/fast-PEXT), or v2.
 RUN make -C cpp tiers -j"$(nproc)"
 
 FROM python:3.11-slim
@@ -17,6 +18,7 @@ WORKDIR /app
 COPY fishnet_tpu/ fishnet_tpu/
 COPY --from=builder /build/cpp/libfishnetcore-v2.so cpp/libfishnetcore-v2.so
 COPY --from=builder /build/cpp/libfishnetcore-v3.so cpp/libfishnetcore-v3.so
+COPY --from=builder /build/cpp/libfishnetcore-v4.so cpp/libfishnetcore-v4.so
 COPY docker-entrypoint.sh /docker-entrypoint.sh
 RUN chmod +x /docker-entrypoint.sh
 CMD ["/docker-entrypoint.sh"]

@@ -61,8 +61,8 @@ def _build() -> None:
 
 def _candidate_libraries() -> list:
     """Libraries to try, best first: FISHNET_TPU_CORE_LIB env >
-    host-built -march=native library > best CPU-feature tier (v3 with
-    fast PEXT, then v2 — mirroring the reference's tier selection and
+    host-built -march=native library > best CPU-feature tier (v4, then v3
+    with fast PEXT, then v2 — mirroring the reference's tier selection and
     AMD slow-PEXT heuristic, assets.rs:86-126). Later candidates are
     fallbacks for earlier ones that fail the ABI handshake (e.g. a
     stale host build next to freshly shipped tiers)."""
@@ -80,7 +80,12 @@ def _candidate_libraries() -> list:
     from fishnet_tpu.chess.cpu import detect
 
     tier = detect().best_tier()
-    tiers = {"v3": ["v3", "v2"], "v2": ["v2"], "arm64": ["arm64"]}.get(tier, [])
+    tiers = {
+        "v4": ["v4", "v3", "v2"],
+        "v3": ["v3", "v2"],
+        "v2": ["v2"],
+        "arm64": ["arm64"],
+    }.get(tier, [])
     for t in tiers:
         path = _CPP_DIR / f"libfishnetcore-{t}.so"
         if path.exists():

@@ -5,9 +5,9 @@ picks the best at startup (assets.rs:86-126), including a heuristic
 that treats BMI2/PEXT as *slow* on AMD before Zen 3 (family < 0x19,
 assets.rs:94-108) — those chips microcode-emulate PEXT, so the
 SSE-level build outruns the BMI2 one. This module mirrors that logic
-for the two portable tiers `make tiers` produces (x86-64-v2 and
-x86-64-v3); a host-built -march=native library always wins when
-present.
+for the portable tiers `make tiers` produces (x86-64-v2, -v3 and
+-v4) plus the aarch64 tier; a host-built -march=native library always
+wins when present.
 """
 
 from __future__ import annotations
@@ -37,10 +37,18 @@ class CpuInfo:
         return True
 
     def best_tier(self) -> Optional[str]:
-        """'v3' (AVX2+fast BMI2), 'v2' (SSE4.2/POPCNT), 'arm64'
-        (aarch64), or None."""
+        """'v4' (AVX-512), 'v3' (AVX2+fast BMI2), 'v2' (SSE4.2/POPCNT),
+        'arm64' (aarch64), or None."""
         if self.arch in ("aarch64", "arm64"):
             return "arm64"
+        # x86-64-v4 needs the AVX-512 F/BW/CD/DQ/VL group (and still
+        # benefits from fast PEXT — BMI2 is part of v3's baseline).
+        if (
+            {"avx512f", "avx512bw", "avx512cd", "avx512dq", "avx512vl"}
+            <= self.flags
+            and self.fast_pext
+        ):
+            return "v4"
         if {"avx2", "bmi2"} <= self.flags and self.fast_pext:
             return "v3"
         if {"sse4_2", "popcnt"} <= self.flags:

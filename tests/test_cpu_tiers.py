@@ -81,7 +81,16 @@ def test_tier_builds_load_and_pass_perft():
         pytest.skip("x86-64 tier builds")
     subprocess.run(["make", "-C", str(CPP_DIR), "tiers", "-j2"], check=True,
                    capture_output=True)
-    for tier in ("v2", "v3"):
+    # Only EXECUTE tiers the host can run: dlopen of a higher tier
+    # succeeds, but its instructions SIGILL the whole process (e.g. v4
+    # on a non-AVX-512 CI runner). best_tier() ranks host capability.
+    from fishnet_tpu.chess.cpu import detect
+
+    rank = {"v2": 2, "v3": 3, "v4": 4}
+    host = rank.get(detect().best_tier() or "", 0)
+    runnable = [t for t in ("v2", "v3", "v4") if rank[t] <= host]
+    assert runnable, "host below x86-64-v2; tier artifacts unusable here"
+    for tier in runnable:
         lib = ctypes.CDLL(str(CPP_DIR / f"libfishnetcore-{tier}.so"))
         lib.fc_init()
         err = ctypes.create_string_buffer(256)
@@ -93,3 +102,17 @@ def test_tier_builds_load_and_pass_perft():
         assert pos
         lib.fc_perft.restype = ctypes.c_uint64
         assert lib.fc_perft(ctypes.c_void_p(pos), 4) == 197281
+
+
+def test_avx512_gets_v4():
+    info = CpuInfo(
+        vendor="GenuineIntel", family=6,
+        flags=frozenset({
+            "sse4_2", "popcnt", "avx2", "bmi2", "avx512f", "avx512bw",
+            "avx512cd", "avx512dq", "avx512vl",
+        }),
+    )
+    assert info.best_tier() == "v4"
+    # Pre-Zen4-style AMD with microcoded PEXT: demoted past v4 AND v3.
+    amd = CpuInfo(vendor="AuthenticAMD", family=0x17, flags=info.flags)
+    assert amd.best_tier() == "v2"
