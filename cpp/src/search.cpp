@@ -24,59 +24,100 @@ size_t floor_pow2(size_t n) {
 }  // namespace
 
 TranspositionTable::TranspositionTable(size_t bytes) {
-  size_t count = floor_pow2(std::max<size_t>(1024, bytes / sizeof(TTEntry)));
-  entries_.resize(count);
-  mask_ = count - 1;
+  size_t clusters = floor_pow2(
+      std::max<size_t>(256, bytes / (sizeof(TTEntry) * CLUSTER)));
+  entries_.resize(clusters * CLUSTER);
+  mask_ = clusters - 1;
 }
 
 TTEntry* TranspositionTable::probe(uint64_t key, bool& hit) {
-  TTEntry* e = &entries_[key & mask_];
-  // An entry counts as a hit if it carries either a search bound or a
-  // cached static eval for this key.
-  hit = e->key == key && (e->bound != TT_NONE || e->eval != TT_EVAL_NONE);
-  return e;
+  TTEntry* c = cluster(key);
+  for (int i = 0; i < CLUSTER; i++) {
+    // An entry counts as a hit if it carries either a search bound or a
+    // cached static eval for this key.
+    if (c[i].key == key &&
+        (c[i].bound != TT_NONE || c[i].eval != TT_EVAL_NONE)) {
+      hit = true;
+      return &c[i];
+    }
+  }
+  hit = false;
+  return c;
 }
 
 void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
                                int depth, TTBound bound) {
-  TTEntry* e = &entries_[key & mask_];
-  // Depth-preferred within a generation; always replace stale entries.
-  if (e->bound == TT_NONE || e->gen != gen_ || e->key != key ||
-      depth >= e->depth || bound == TT_EXACT) {
-    if (e->key == key) {
-      if (move == MOVE_NONE) move = e->move;  // keep old best move
-      if (eval == TT_EVAL_NONE) eval = e->eval;  // keep cached static eval
+  TTEntry* c = cluster(key);
+  TTEntry* e = nullptr;
+  for (int i = 0; i < CLUSTER; i++)
+    if (c[i].key == key) {
+      e = &c[i];
+      break;
     }
-    e->key = key;
-    e->move = move;
-    e->value = int16_t(value);
-    e->eval = int16_t(eval);
-    e->depth = uint8_t(std::max(0, depth));
-    e->bound = bound;
-    e->gen = gen_;
+  if (e != nullptr) {
+    // Same position: depth-preferred within a generation, merging the
+    // old best move / cached eval when the new store lacks them.
+    if (e->bound != TT_NONE && e->gen == gen_ && depth < e->depth &&
+        bound != TT_EXACT)
+      return;
+    if (move == MOVE_NONE) move = e->move;
+    if (eval == TT_EVAL_NONE) eval = e->eval;
+  } else {
+    // Victim: the weakest of the cluster — stale generations first,
+    // then shallowest depth (eval-only entries have depth 0 and go
+    // before any bound-carrying entry of equal staleness).
+    int worst = 1 << 30;
+    for (int i = 0; i < CLUSTER; i++) {
+      int score = int(c[i].depth) + (c[i].gen == gen_ ? 512 : 0) +
+                  (c[i].bound != TT_NONE ? 256 : 0);
+      if (score < worst) {
+        worst = score;
+        e = &c[i];
+      }
+    }
+    // When even the weakest slot holds a fresh, bound-carrying, deeper
+    // entry, drop the store: under pressure, deep results are worth
+    // more than this shallower one (measured — evicting them cost a
+    // third of a ply at a 2 MiB table).
+    if (e->bound != TT_NONE && e->gen == gen_ && e->depth > depth &&
+        bound != TT_EXACT)
+      return;
   }
+  e->key = key;
+  e->move = move;
+  e->value = int16_t(value);
+  e->eval = int16_t(eval);
+  e->depth = uint8_t(std::max(0, depth));
+  e->bound = bound;
+  e->gen = gen_;
 }
 
 void TranspositionTable::store_eval(uint64_t key, int eval, bool speculative) {
-  TTEntry* e = &entries_[key & mask_];
-  if (e->key == key) {
-    if (e->eval == TT_EVAL_NONE) {
-      e->eval = int16_t(eval);
-      e->prefetched = speculative ? 1 : 0;
+  TTEntry* c = cluster(key);
+  TTEntry* free_slot = nullptr;
+  for (int i = 0; i < CLUSTER; i++) {
+    if (c[i].key == key) {
+      if (c[i].eval == TT_EVAL_NONE) {
+        c[i].eval = int16_t(eval);
+        c[i].prefetched = speculative ? 1 : 0;
+      }
+      return;
     }
-    return;
+    if (free_slot == nullptr && c[i].bound == TT_NONE &&
+        c[i].eval == TT_EVAL_NONE)
+      free_slot = &c[i];
   }
-  // Only claim genuinely empty entries: a speculative eval (many of which
-  // are never even visited) must not evict another search's bounds.
-  if (e->bound == TT_NONE && e->eval == TT_EVAL_NONE) {
-    e->key = key;
-    e->move = MOVE_NONE;
-    e->value = 0;
-    e->eval = int16_t(eval);
-    e->depth = 0;
-    e->bound = TT_NONE;
-    e->gen = gen_;
-    e->prefetched = speculative ? 1 : 0;
+  // Only claim genuinely empty slots: a speculative eval (many of which
+  // are never even visited) must not evict another search's entries.
+  if (free_slot != nullptr) {
+    free_slot->key = key;
+    free_slot->move = MOVE_NONE;
+    free_slot->value = 0;
+    free_slot->eval = int16_t(eval);
+    free_slot->depth = 0;
+    free_slot->bound = TT_NONE;
+    free_slot->gen = gen_;
+    free_slot->prefetched = speculative ? 1 : 0;
   }
 }
 

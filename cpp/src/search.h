@@ -98,19 +98,31 @@ struct TTEntry {
 
 class TranspositionTable {
  public:
+  // 4-way clusters: a direct-mapped table loses entries to index
+  // collisions exactly when it matters (thousands of concurrent
+  // searches sharing one table); within a cluster the weakest entry —
+  // stale generation first, then shallowest depth — is the victim.
+  static constexpr int CLUSTER = 4;
+
   explicit TranspositionTable(size_t bytes = 256ull << 20);
+  // On hit, the matching entry. On miss, some entry of the cluster —
+  // callers must not read it (every call site guards on `hit`).
   TTEntry* probe(uint64_t key, bool& hit);
   void store(uint64_t key, Move move, int value, int eval, int depth, TTBound bound);
   // Cache a speculative static eval without ever evicting an entry that
-  // carries a search bound for a different key — prefetched evals are
-  // cheap and must not degrade the shared table's hit quality.
+  // carries a search bound or eval for a different key — prefetched
+  // evals are cheap and must not degrade the shared table's quality;
+  // with 4-way clusters there are four chances to find a free slot.
   // `speculative` tags the entry for prefetch hit-rate accounting.
   void store_eval(uint64_t key, int eval, bool speculative = false);
   void new_generation() { gen_++; }
 
  private:
+  TTEntry* cluster(uint64_t key) {
+    return &entries_[(key & mask_) * CLUSTER];
+  }
   std::vector<TTEntry> entries_;
-  size_t mask_;
+  size_t mask_;  // cluster-index mask
   uint16_t gen_ = 0;
 };
 
