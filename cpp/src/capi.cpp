@@ -9,6 +9,7 @@
 
 #include "nnue.h"
 #include "position.h"
+#include "search.h"
 
 using namespace fc;
 
@@ -137,6 +138,10 @@ NnueNet* fc_nnue_load(const char* path, char* err, int errlen) {
 
 void fc_nnue_free(NnueNet* net) { delete net; }
 
+int fc_nnue_material_correlated(const NnueNet* net) {
+  return nnue_material_correlated(*net) ? 1 : 0;
+}
+
 int fc_nnue_evaluate(const NnueNet* net, const Position* pos) {
   if (pos->variant != VR_STANDARD) return INT32_MIN;  // NNUE needs both kings
   return nnue_evaluate(*net, *pos);
@@ -153,5 +158,14 @@ int fc_pos_features(const Position* pos, int perspective_rel, int32_t* out) {
 
 // Layer-stack / PSQT bucket of the position.
 int fc_pos_psqt_bucket(const Position* pos) { return nnue_psqt_bucket(*pos); }
+
+// Static exchange evaluation of a UCI move (search.h see()); exposed so
+// the Python suite can pin the exchange oracle against hand-computed
+// sequences. Returns INT32_MIN when the move does not parse.
+int fc_pos_see(const Position* pos, const char* uci) {
+  Move m = pos->parse_uci(uci);
+  if (m == MOVE_NONE) return INT32_MIN;
+  return see(*pos, m);
+}
 
 }  // extern "C"

@@ -163,4 +163,41 @@ int nnue_evaluate(const NnueNet& net, const Position& pos) {
   return (positional + material) / 16;
 }
 
+bool nnue_material_correlated(const NnueNet& net) {
+  // Fixed probe pairs: (base, base with one major piece deleted, sign).
+  // sign +1 means the mutated position must evaluate LOWER for white
+  // (white lost the piece) by >= margin; -1 means higher (black lost
+  // it). All four must hold — a material-blind (random) net passes the
+  // joint test with only a few percent probability, while any net
+  // trained on search scores clears a queen/rook margin by hundreds of
+  // centipawns.
+  struct Probe {
+    const char* base;
+    const char* mutated;
+    int sign;
+  };
+  static const Probe kProbes[] = {
+      {"r1bqk2r/ppp2ppp/2np1n2/2b1p3/2B1P3/2PP1N2/PP3PPP/RNBQK2R w KQkq - 0 6",
+       "r1bqk2r/ppp2ppp/2np1n2/2b1p3/2B1P3/2PP1N2/PP3PPP/RNB1K2R w KQkq - 0 6",
+       +1},
+      {"r1bqk2r/ppp2ppp/2np1n2/2b1p3/2B1P3/2PP1N2/PP3PPP/RNBQK2R w KQkq - 0 6",
+       "r1b1k2r/ppp2ppp/2np1n2/2b1p3/2B1P3/2PP1N2/PP3PPP/RNBQK2R w KQkq - 0 6",
+       -1},
+      {"4k3/8/8/8/8/8/4P3/R3K3 w - - 0 1",
+       "4k3/8/8/8/8/8/4P3/4K3 w - - 0 1", +1},
+      {"3qk3/8/8/8/8/8/8/3QK3 w - - 0 1",
+       "3qk3/8/8/8/8/8/8/4K3 w - - 0 1", +1},
+  };
+  constexpr int kMargin = 150;
+  for (const Probe& p : kProbes) {
+    Position base, mutated;
+    if (!base.set_fen(p.base, VR_STANDARD).empty()) return false;
+    if (!mutated.set_fen(p.mutated, VR_STANDARD).empty()) return false;
+    // Both probes are white to move; evals are stm (= white) relative.
+    int delta = nnue_evaluate(net, base) - nnue_evaluate(net, mutated);
+    if (p.sign * delta < kMargin) return false;
+  }
+  return true;
+}
+
 }  // namespace fc

@@ -85,4 +85,15 @@ inline int nnue_psqt_bucket(const Position& pos) {
 // Full evaluation in centipawns from the side-to-move's point of view.
 int nnue_evaluate(const NnueNet& net, const Position& pos);
 
+// Does this net's eval track material? Probes a handful of fixed
+// positions with one side's queen/rook deleted and checks the eval
+// moves the way material says it must. Real nets (trained on search
+// scores) always pass; random test nets essentially never do. Search
+// uses this to decide whether SEE-based capture demotion and qsearch
+// SEE pruning are sound for the loaded net — those heuristics assume
+// exchanges that lose material lose eval, and enabling them under a
+// material-blind net was measured to cost ~35% tree size (the pruned
+// captures' subtrees are the cheap ones to search).
+bool nnue_material_correlated(const NnueNet& net);
+
 }  // namespace fc

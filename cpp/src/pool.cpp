@@ -211,8 +211,15 @@ struct SearchPool {
   // Written by the scheduler thread, read by it too (via the bridge);
   // atomic only for the telemetry read.
   std::atomic<int> prefetch_budget{EVAL_BLOCK_MAX};
+  // fc_pool_set_prefetch pins the budget (parity suites need identical
+  // TT evolution across backends; ROI experiments need fixed points).
+  // Atomic: written from caller threads while the scheduler reads it.
+  std::atomic<bool> prefetch_adaptive{true};
   std::unique_ptr<NnueNet> scalar_net;
   std::unique_ptr<ScalarEval> scalar_eval;
+  // Whether the loaded net's eval tracks material (probed once at pool
+  // creation): gates the SEE heuristics whose soundness depends on it.
+  bool net_material_correlated = false;
   HceEval hce_eval;  // variant searches (immediate, CPU)
   std::vector<std::unique_ptr<Slot>> slots;
   // Slots are partitioned into n_groups (slot id mod n_groups) so the
@@ -258,6 +265,8 @@ SearchPool* fc_pool_new(int max_slots, uint64_t tt_bytes,
       return nullptr;
     }
     pool->scalar_eval = std::make_unique<ScalarEval>(pool->scalar_net.get());
+    pool->net_material_correlated =
+        nnue_material_correlated(*pool->scalar_net);
   }
   return pool;
 }
@@ -325,6 +334,17 @@ int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
   if (!slot.bridge)
     slot.bridge = std::make_unique<BatchedEval>(&slot, &pool->prefetch_budget);
   return id;
+}
+
+// Pin (adaptive=0) or re-seed (adaptive=1) the speculation budget.
+// Pinned budgets make TT evolution a deterministic function of the
+// submission sequence — required by the cross-backend parity suites —
+// and give ROI experiments fixed operating points.
+void fc_pool_set_prefetch(SearchPool* pool, int budget, int adaptive) {
+  if (budget < 0) budget = 0;
+  if (budget > EVAL_BLOCK_MAX) budget = EVAL_BLOCK_MAX;
+  pool->prefetch_adaptive.store(adaptive != 0, std::memory_order_relaxed);
+  pool->prefetch_budget.store(budget, std::memory_order_relaxed);
 }
 
 void fc_pool_stop(SearchPool* pool, int slot_id) {
@@ -421,7 +441,14 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
           : slot.use_scalar
               ? static_cast<EvalBridge*>(pp->scalar_eval.get())
               : static_cast<EvalBridge*>(slot.bridge.get());
-      slot.search = std::make_unique<Search>(&pp->tt, eval, &pp->counters);
+      // HCE is material by construction; NNUE searches get the full SEE
+      // policy only when the loaded net's eval was probed to track
+      // material (random test nets must not be pruned by material logic).
+      bool see_full = slot.root.variant != VR_STANDARD
+                          ? true
+                          : pp->net_material_correlated;
+      slot.search =
+          std::make_unique<Search>(&pp->tt, eval, &pp->counters, see_full);
       slot.fiber->start([sp] {
         sp->result = sp->search->run(sp->root, sp->history, sp->limits);
       });
@@ -453,13 +480,22 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
     pool->evals_shipped.fetch_add(batch.size(), std::memory_order_relaxed);
     // Adapt the speculation budget to batch pressure (see the field's
     // comment): multiplicative decrease on overflow, slow additive
-    // growth while there is slack.
-    int budget = pool->prefetch_budget.load(std::memory_order_relaxed);
-    if (overflow)
-      pool->prefetch_budget.store(std::max(1, budget / 2),
-                                  std::memory_order_relaxed);
-    else if (int(batch.size()) * 2 < capacity && budget < EVAL_BLOCK_MAX)
-      pool->prefetch_budget.store(budget + 1, std::memory_order_relaxed);
+    // growth while there is slack. The floor is 0, not 1: when
+    // speculation is not earning (VERDICT r2: ROI 0.0008 before the
+    // store_eval fix), the policy must be able to turn it off outright.
+    if (pool->prefetch_adaptive.load(std::memory_order_relaxed)) {
+      // CAS, not store: a concurrent fc_pool_set_prefetch pin must not
+      // be clobbered by an AIMD update computed from the pre-pin value
+      // (with adaptive then false, nothing would ever correct it).
+      int budget = pool->prefetch_budget.load(std::memory_order_relaxed);
+      int next = overflow ? budget / 2
+                 : (int(batch.size()) * 2 < capacity && budget < EVAL_BLOCK_MAX)
+                     ? budget + 1
+                     : budget;
+      if (next != budget)
+        pool->prefetch_budget.compare_exchange_strong(
+            budget, next, std::memory_order_relaxed);
+    }
   }
   return int(batch.size());
 }

@@ -90,11 +90,23 @@ void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
   e->depth = uint8_t(std::max(0, depth));
   e->bound = bound;
   e->gen = gen_;
+  // A repurposed victim slot must not inherit a stale speculative tag:
+  // the next TT eval hit on this key would count a false prefetch hit
+  // and inflate the ROI telemetry the budget policy is tuned against.
+  e->prefetched = 0;
 }
 
 void TranspositionTable::store_eval(uint64_t key, int eval, bool speculative) {
   TTEntry* c = cluster(key);
-  TTEntry* free_slot = nullptr;
+  // Victim ranking among bound-free slots (bound-carrying entries are
+  // never evicted by a cheap static eval): empty beats unconsumed
+  // speculative beats stale-generation eval-only. Round 2 claimed only
+  // genuinely EMPTY slots, which silently dropped nearly every prefetch
+  // once the table warmed up (measured ROI 0.0008): each dropped child
+  // eval then cost a fresh demand round-trip, the exact latency the
+  // prefetch was bought to hide.
+  TTEntry* victim = nullptr;
+  int victim_rank = 0;
   for (int i = 0; i < CLUSTER; i++) {
     if (c[i].key == key) {
       if (c[i].eval == TT_EVAL_NONE) {
@@ -103,21 +115,25 @@ void TranspositionTable::store_eval(uint64_t key, int eval, bool speculative) {
       }
       return;
     }
-    if (free_slot == nullptr && c[i].bound == TT_NONE &&
-        c[i].eval == TT_EVAL_NONE)
-      free_slot = &c[i];
+    if (c[i].bound != TT_NONE) continue;
+    int rank = c[i].eval == TT_EVAL_NONE ? 3   // empty
+               : c[i].prefetched         ? 2   // unconsumed speculation
+               : c[i].gen != gen_        ? 1   // stale cached eval
+                                         : 0;  // fresh demand eval: keep
+    if (rank > victim_rank) {
+      victim_rank = rank;
+      victim = &c[i];
+    }
   }
-  // Only claim genuinely empty slots: a speculative eval (many of which
-  // are never even visited) must not evict another search's entries.
-  if (free_slot != nullptr) {
-    free_slot->key = key;
-    free_slot->move = MOVE_NONE;
-    free_slot->value = 0;
-    free_slot->eval = int16_t(eval);
-    free_slot->depth = 0;
-    free_slot->bound = TT_NONE;
-    free_slot->gen = gen_;
-    free_slot->prefetched = speculative ? 1 : 0;
+  if (victim != nullptr) {
+    victim->key = key;
+    victim->move = MOVE_NONE;
+    victim->value = 0;
+    victim->eval = int16_t(eval);
+    victim->depth = 0;
+    victim->bound = TT_NONE;
+    victim->gen = gen_;
+    victim->prefetched = speculative ? 1 : 0;
   }
 }
 
@@ -139,6 +155,63 @@ void value_to_uci(int value, bool& mate, int& out) {
 }
 
 // ---------------------------------------------------------------------------
+// Static exchange evaluation
+// ---------------------------------------------------------------------------
+
+int see(const Position& pos, Move m) {
+  if (move_kind(m) == MK_CASTLE || move_kind(m) == MK_DROP) return 0;
+  Square from = move_from(m), to = move_to(m);
+  int gain[34];
+  int d = 0;
+  Bitboard occ = pos.occupied() ^ bb(from);
+  if (move_kind(m) == MK_EN_PASSANT) {
+    occ ^= bb(to + (pos.stm == WHITE ? -8 : 8));
+    gain[0] = kPieceValue[PAWN];
+  } else {
+    gain[0] = pos.empty(to) ? 0 : kPieceValue[piece_type(pos.piece_on(to))];
+  }
+  int next_victim = piece_type(pos.piece_on(from));
+  if (move_promo(m) != NO_PIECE_TYPE) {
+    next_victim = move_promo(m);
+    gain[0] += kPieceValue[next_victim] - kPieceValue[PAWN];
+  }
+  Color side = ~pos.stm;
+  while (d < 32) {
+    // Recompute attackers under the shrinking occupancy so sliders
+    // x-ray through departed pieces; mask with occ to drop attackers
+    // already spent (the position's bitboards still contain them).
+    Bitboard attackers = pos.attackers_to(to, occ) & occ;
+    Bitboard ours = attackers & pos.pieces(side);
+    if (!ours) break;
+    int apt = PAWN;
+    Bitboard from_bb = 0;
+    for (; apt <= KING; apt++) {
+      from_bb = ours & pos.pieces(PieceType(apt));
+      if (from_bb) break;
+    }
+    Bitboard fb = from_bb & -from_bb;
+    // The king may only recapture when no enemy attacker remains
+    // (x-rays through its own square included) — capturing into check
+    // ends the sequence instead.
+    if (apt == KING &&
+        ((pos.attackers_to(to, occ ^ fb) & (occ ^ fb)) & pos.pieces(~side)))
+      break;
+    d++;
+    gain[d] = kPieceValue[next_victim] - gain[d - 1];
+    next_victim = apt;
+    occ ^= fb;
+    side = ~side;
+  }
+  // Negamax the gain ladder backwards: at each depth the side to move
+  // keeps the better of stopping (not recapturing) and continuing.
+  while (d > 0) {
+    gain[d - 1] = -std::max(-gain[d - 1], gain[d]);
+    d--;
+  }
+  return gain[0];
+}
+
+// ---------------------------------------------------------------------------
 // Search
 // ---------------------------------------------------------------------------
 
@@ -146,7 +219,12 @@ int Search::evaluate(const Position& pos) {
   // Clamp into the non-mate score range: keeps TT int16 storage exact,
   // avoids the TT_EVAL_NONE sentinel, and prevents huge (e.g. random-net)
   // evals from masquerading as mate scores.
-  if (counters_) counters_->bump(counters_->demand_evals);
+  // Traffic counters track DEVICE batch slots only: scalar/HCE-backed
+  // searches sharing the pool never ship slots, and counting them would
+  // break the identity evals_shipped == demand_evals + prefetch_shipped
+  // that occupancy and cache-rate telemetry are computed from.
+  if (counters_ && eval_->batched())
+    counters_->bump(counters_->demand_evals);
   int v = eval_->evaluate(pos);
   constexpr int LIMIT = VALUE_MATE_IN_MAX - 1;
   return v < -LIMIT ? -LIMIT : (v > LIMIT ? LIMIT : v);
@@ -203,6 +281,16 @@ void Search::order_moves(const Position& pos, MoveList& moves, Move tt_move,
                        : piece_type(pos.piece_on(move_to(m)));
       int attacker = move_kind(m) == MK_DROP ? PAWN : piece_type(pos.piece_on(move_from(m)));
       score = (1 << 20) + victim * 16 - attacker;
+      // Losing captures (SEE < 0) go behind every quiet: MVV-LVA alone
+      // tries QxP-with-the-pawn-defended before killers, wasting the
+      // early slots the whole ordering scheme exists to protect. SEE is
+      // only consulted when the exchange CAN lose (attacker outvalues
+      // victim) — the common winning/equal captures stay zero-cost.
+      // Gated on see_full_: demoting captures only pays when a losing
+      // exchange implies a losing eval (see search.h ctor comment).
+      if (see_full_ && kPieceValue[attacker] > kPieceValue[victim] &&
+          see_applicable(pos.variant) && see(pos, m) < 0)
+        score = -(1 << 20) + victim * 16 - attacker;
     } else if (move_promo(m) == QUEEN) {
       score = (1 << 19);
     } else if (ply < MAX_PLY &&
@@ -349,7 +437,7 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
     int stand;
     if (hit && tte->eval != EVAL_NONE) {
       stand = tte->eval;
-      if (counters_) {
+      if (counters_ && eval_->batched()) {
         counters_->bump(counters_->tt_eval_hits);
         if (tte->prefetched) {
           counters_->bump(counters_->prefetch_hits);
@@ -387,6 +475,15 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
           best + kPieceValue[victim] + 200 <= alpha)
         continue;
     }
+    // SEE pruning: a capture (or promotion push) that loses material on
+    // the exchange cannot beat the stand-pat bound it already failed to
+    // raise — the classic qsearch explosion-limiter MVV-LVA's delta
+    // margins miss (Stockfish prunes the same class via see_ge). Gated
+    // on see_full_ (sound only for material-correlated evals).
+    if (see_full_ && !in_check && !forced_captures &&
+        best > -VALUE_MATE_IN_MAX && see_applicable(pos.variant) &&
+        see(pos, m) < 0)
+      continue;
     Position copy = pos;
     copy.make(m);
     if (ply + 1 <= MAX_PLY) move_stack_[ply + 1] = m;
@@ -524,6 +621,15 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
 
     bool is_quiet = pos.empty(move_to(m)) && move_kind(m) != MK_EN_PASSANT &&
                     move_promo(m) == NO_PIECE_TYPE;
+
+    // SEE pruning for captures at shallow depth: an exchange losing more
+    // than a depth-scaled margin almost never recovers in the remaining
+    // plies. Depth-bounded so deep tactics stay exhaustive; checked
+    // before the copy+make below so pruned moves cost nothing.
+    if (!is_pv && !in_check && !is_quiet && best > -VALUE_INF &&
+        depth <= 5 && std::abs(alpha) < VALUE_MATE_IN_MAX &&
+        see_applicable(pos.variant) && see(pos, m) < -200 * depth)
+      continue;
 
     Position copy = pos;
     copy.make(m);

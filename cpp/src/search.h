@@ -172,9 +172,15 @@ struct SearchResult {
 
 class Search {
  public:
+  // ``see_full``: enable the SEE heuristics that assume the eval tracks
+  // material (losing-capture demotion in move ordering, qsearch SEE
+  // pruning). The pool derives it from nnue_material_correlated() for
+  // NNUE-backed searches and hard-codes true for HCE ones; the
+  // depth-scaled SEE prune in the main search is active regardless (it
+  // was measured to shrink the tree even under a material-blind net).
   Search(TranspositionTable* tt, EvalBridge* eval,
-         SearchCounters* counters = nullptr)
-      : tt_(tt), eval_(eval), counters_(counters) {}
+         SearchCounters* counters = nullptr, bool see_full = true)
+      : tt_(tt), eval_(eval), counters_(counters), see_full_(see_full) {}
 
   // Run a full iterative-deepening search. game_history: Zobrist hashes
   // of positions before root (for repetition detection), most recent last.
@@ -203,6 +209,7 @@ class Search {
   TranspositionTable* tt_;
   EvalBridge* eval_;
   SearchCounters* counters_ = nullptr;
+  bool see_full_ = true;
   uint64_t nodes_ = 0;
   uint64_t node_limit_ = 0;
   bool stopped_ = false;
@@ -233,5 +240,21 @@ class Search {
 // Convert an internal value to (is_mate, value-for-uci): mate distance in
 // moves from the root's side to move, or centipawns.
 void value_to_uci(int value, bool& mate, int& out);
+
+// Static exchange evaluation of move m: material outcome (centipawns,
+// mover's point of view) of the capture sequence on the target square
+// with both sides recapturing by least valuable attacker; sliding
+// x-rays are uncovered as the exchange empties squares. Ordering and
+// pruning heuristic only — never part of a returned score. Pins are
+// ignored (standard engine practice; Stockfish's SEE does the same).
+int see(const Position& pos, Move m);
+
+// Whether SEE's standard-capture assumptions hold for a variant: atomic
+// explodes the exchange square (a "losing" capture may win outright)
+// and antichess both inverts piece worth and removes the right to
+// decline a recapture.
+inline bool see_applicable(VariantRules v) {
+  return v != VR_ATOMIC && v != VR_ANTICHESS;
+}
 
 }  // namespace fc

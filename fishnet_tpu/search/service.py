@@ -107,6 +107,9 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
     ]
     lib.fc_pool_counters.restype = ctypes.c_int
+    lib.fc_pool_set_prefetch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+    ]
     lib._pool_bound = True
 
 
@@ -369,6 +372,15 @@ class SearchService:
     def poke(self) -> None:
         """Wake the driver (after setting a search's stop_event)."""
         self._wake.set()
+
+    def set_prefetch(self, budget: int, adaptive: bool = True) -> None:
+        """Pin (adaptive=False) or re-seed the pool's speculation budget.
+        Pinning makes TT evolution deterministic across backends — the
+        cross-backend parity suites rely on it; budget=0 disables
+        speculative prefetch outright."""
+        self._lib.fc_pool_set_prefetch(
+            self._pool, int(budget), 1 if adaptive else 0
+        )
 
     def counters(self) -> Dict[str, int]:
         """Cumulative eval-traffic counters from the native pool —

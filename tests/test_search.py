@@ -183,6 +183,104 @@ async def test_eval_traffic_counters_and_adaptive_budget():
         svc.close()
 
 
+def _see(fen, uci, variant=None):
+    import ctypes
+
+    from fishnet_tpu.chess import Board
+    from fishnet_tpu.chess.core import load
+
+    lib = load()
+    if not hasattr(lib.fc_pos_see, "_bound"):
+        lib.fc_pos_see.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.fc_pos_see.restype = ctypes.c_int
+        lib.fc_pos_see._bound = True
+    board = Board(fen) if variant is None else Board(fen, variant=variant)
+    return lib.fc_pos_see(board._pos, uci.encode())
+
+
+def test_see_exchange_oracle():
+    """Static exchange evaluation against hand-computed capture
+    sequences (cpp/src/search.cpp see()) — the capture-ordering and
+    qsearch-pruning heuristic the reference gets from Stockfish's
+    see_ge (VERDICT r2 missing feature #2)."""
+    # Undefended pawn grab: clean +100.
+    assert _see("1k6/8/8/2p5/8/8/2R5/1K6 w - - 0 1", "c2c5") == 100
+    # Pawn takes pawn, defended by a pawn: equal trade.
+    assert _see("1k6/8/3p4/2p5/3P4/8/8/1K6 w - - 0 1", "d4c5") == 0
+    # Queen takes a pawn defended by a pawn: loses queen for two pawns.
+    assert _see("1k6/8/3p4/2p5/8/8/2Q5/1K6 w - - 0 1", "c2c5") == 100 - 950
+    # Doubled rooks vs pawn defended by pawn and rook (x-ray through the
+    # front rook): RxP pxR stops there for white: -400.
+    assert _see("4r1k1/8/3p4/4p3/8/8/4R3/4R1K1 w - - 0 1", "e2e5") == -400
+    # En passant, retaken by a pawn: equal.
+    assert _see("1k6/8/8/8/1pP5/8/1P6/1K6 b - c3 0 1", "b4c3") == 0
+    # Quiet promotion into a rook's guard: new queen falls, pawn lost.
+    assert _see("1r5k/P7/8/8/8/8/8/K7 w - - 0 1", "a7a8q") == -100
+    # King recaptures a rook that grabbed a king-defended pawn.
+    assert _see("8/8/8/3k4/3p4/8/3R4/3K4 w - - 0 1", "d2d4") == 100 - 500
+    # Same, but the king's recapture square is covered by a bishop: the
+    # king may not recapture into check, so the pawn grab stands.
+    assert _see("8/8/8/3k4/3p4/8/1B1R4/3K4 w - - 0 1", "d2d4") == 100
+
+
+def material_net():
+    """A NnueWeights whose eval IS material: zero everywhere except the
+    PSQT rows, which carry piece values (+ for the perspective's own
+    pieces, - for the opponent's). material = (stm - opp)/2 then /16
+    (spec FV_SCALE), so the probe margins clear by construction."""
+    import numpy as np
+
+    from fishnet_tpu.nnue import spec
+
+    w = NnueWeights.random(seed=0)
+    for f in ("ft_weight", "ft_bias", "l1_weight", "l1_bias", "l2_weight",
+              "l2_bias", "out_weight", "out_bias"):
+        getattr(w, f)[...] = 0
+    vals = [3200, 10240, 10560, 16000, 30400, 0]  # P N B R Q K (x32)
+    psqt = np.zeros((spec.NUM_FEATURES, spec.NUM_PSQT_BUCKETS), np.int32)
+    for plane in range(spec.NUM_PLANES):
+        pt, theirs = divmod(plane, 2) if plane < 10 else (5, 0)
+        v = vals[pt] * (-1 if theirs else 1)
+        for kb in range(spec.NUM_KING_BUCKETS):
+            base = kb * spec.FEATURES_PER_BUCKET + plane * 64
+            psqt[base : base + 64] = v
+    w.ft_psqt[...] = psqt
+    return w
+
+
+def test_material_correlation_probe():
+    """nnue_material_correlated (cpp/src/nnue.cpp) gates the SEE
+    heuristics whose premise is a material-tracking eval: it must accept
+    a material net and reject a random one (random nets drive the test
+    and bench suites; pruning their searches by material logic was
+    measured to inflate the tree ~35%)."""
+    import ctypes
+    import tempfile
+
+    from fishnet_tpu.chess.core import load
+
+    lib = load()
+    if not hasattr(lib.fc_nnue_material_correlated, "_bound"):
+        lib.fc_nnue_material_correlated.argtypes = [ctypes.c_void_p]
+        lib.fc_nnue_material_correlated.restype = ctypes.c_int
+        lib.fc_nnue_material_correlated._bound = True
+
+    def probe(weights):
+        with tempfile.NamedTemporaryFile(suffix=".nnue") as f:
+            weights.save(f.name)
+            err = ctypes.create_string_buffer(256)
+            net = lib.fc_nnue_load(f.name.encode(), err, len(err))
+            assert net, err.value
+            try:
+                return bool(lib.fc_nnue_material_correlated(net))
+            finally:
+                lib.fc_nnue_free(net)
+
+    assert probe(material_net())
+    assert not probe(NnueWeights.random(seed=7))  # the bench net
+    assert not probe(NnueWeights.random(seed=21))  # the parity-suite net
+
+
 def _random_fens(n, seed):
     import random
 
