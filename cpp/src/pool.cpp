@@ -26,6 +26,8 @@
 #include <deque>
 #include <memory>
 #include <string>
+#include <tuple>
+#include <unordered_map>
 #include <vector>
 
 #include "fiber.h"
@@ -34,6 +36,7 @@
 #include "search.h"
 
 namespace fc {
+
 namespace {
 
 int copy_str(const std::string& s, char* buf, int len) {
@@ -48,8 +51,8 @@ struct Slot;
 // Block requests (prefetched siblings/children) ride one suspension.
 class BatchedEval : public EvalBridge {
  public:
-  BatchedEval(Slot* slot, const std::atomic<int>* budget)
-      : slot_(slot), budget_(budget) {}
+  BatchedEval(Slot* slot, const NnueNet* net, const std::atomic<int>* budget)
+      : slot_(slot), net_(net), budget_(budget) {}
   int evaluate(const Position& pos) override;
   void evaluate_block(const Position* positions, int n, int32_t* out) override;
   bool batched() const override { return true; }
@@ -60,6 +63,7 @@ class BatchedEval : public EvalBridge {
 
  private:
   Slot* slot_;
+  const NnueNet* net_;  // PSQT table for the host-side material term
   const std::atomic<int>* budget_;
 };
 
@@ -87,6 +91,15 @@ struct Slot {
   int block_n = 0;
   uint16_t features[EVAL_BLOCK_MAX][2][NNUE_MAX_ACTIVE];
   int32_t buckets[EVAL_BLOCK_MAX];
+  // Per-entry PSQT accumulators, all 8 buckets x both perspectives (stm
+  // first), filled host-side during feature extraction: the material
+  // term is a ~60-load walk over an L2-resident 720 KB table here,
+  // versus a random-gather over an 11 MB padded table on the device —
+  // the one NNUE term that is CHEAPER on the scalar side. The wire
+  // ships only the bucket-selected material value (4 bytes/entry).
+  int32_t psqt[EVAL_BLOCK_MAX][2][NNUE_PSQT_BUCKETS];
+  // Bucket-selected material term per entry, ready for the wire.
+  int32_t material[EVAL_BLOCK_MAX];
   // Incremental-eval reference, block-relative: -1 = standalone full
   // feature set; else (ref_entry << 1) | persp_swap, meaning this
   // entry's features are DELTAS against that (always-full) entry's
@@ -94,17 +107,32 @@ struct Slot {
   // move differ. Rebased to batch-relative indices at emission.
   int32_t parent_code[EVAL_BLOCK_MAX];
   int32_t eval_values[EVAL_BLOCK_MAX];
+  // Position hash per entry: the key for in-step deduplication.
+  uint64_t entry_hash[EVAL_BLOCK_MAX];
+  // True while this slot's single-entry request is aliased onto another
+  // entry of the in-flight batch (no slot of its own shipped); the
+  // step loops must not re-emit it until provide() fans the value out.
+  bool alias_pending = false;
 };
 
 namespace {
 
-// Full feature extraction for block entry j.
-void fill_full(Slot* slot, int j, const Position& pos) {
+// Full feature extraction for block entry j, including the host-side
+// PSQT accumulators (all 8 buckets; the emission picks the entry's own
+// bucket and ships one material int32).
+void fill_full(Slot* slot, const NnueNet* net, int j, const Position& pos) {
   for (int p = 0; p < 2; p++) {
-    int cnt = nnue_features(pos, p == 0 ? pos.stm : ~pos.stm,
-                            slot->features[j][p]);
+    uint16_t* row = slot->features[j][p];
+    int cnt = nnue_features(pos, p == 0 ? pos.stm : ~pos.stm, row);
+    int32_t* ps = slot->psqt[j][p];
+    for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) ps[b] = 0;
+    for (int i = 0; i < cnt; i++) {
+      const int32_t* prow =
+          &net->ft_psqt[size_t(row[i]) * NNUE_PSQT_BUCKETS];
+      for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) ps[b] += prow[b];
+    }
     for (int i = cnt; i < NNUE_MAX_ACTIVE; i++)
-      slot->features[j][p][i] = uint16_t(NNUE_FEATURES);
+      row[i] = uint16_t(NNUE_FEATURES);
   }
   slot->parent_code[j] = -1;
 }
@@ -124,8 +152,8 @@ void fill_full(Slot* slot, int j, const Position& pos) {
 // — a ~4x cut in row DMAs for the prefetch-block children that
 // dominate batch traffic (one move touches at most 2 adds / 3 removes:
 // mover or promotion to-piece, plus from-square, victim, e.p. pawn).
-bool fill_delta(Slot* slot, int j, const Position& ref, const Position& pos,
-                int ref_entry) {
+bool fill_delta(Slot* slot, const NnueNet* net, int j, const Position& ref,
+                const Position& pos, int ref_entry) {
   constexpr int DELTA_SLOTS = NNUE_DELTA_SLOTS;
   bool swap = pos.stm != ref.stm;
   for (int p = 0; p < 2; p++) {
@@ -156,6 +184,20 @@ bool fill_delta(Slot* slot, int j, const Position& ref, const Position& pos,
           NNUE_DELTA_BASE + (i < n_rem ? rems[i] : uint16_t(NNUE_FEATURES)));
     for (int i = 2 * DELTA_SLOTS; i < NNUE_MAX_ACTIVE; i++)
       row[i] = uint16_t(NNUE_FEATURES);
+    // PSQT: parent's accumulator for the SAME COLOR (parent perspective
+    // p^swap), plus the delta rows. Kings match (checked above), so the
+    // child's feature indexing agrees with the parent's for this color.
+    const int32_t* ref_ps = slot->psqt[ref_entry][swap ? p ^ 1 : p];
+    int32_t* ps = slot->psqt[j][p];
+    for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) ps[b] = ref_ps[b];
+    for (int i = 0; i < n_add; i++) {
+      const int32_t* prow = &net->ft_psqt[size_t(adds[i]) * NNUE_PSQT_BUCKETS];
+      for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) ps[b] += prow[b];
+    }
+    for (int i = 0; i < n_rem; i++) {
+      const int32_t* prow = &net->ft_psqt[size_t(rems[i]) * NNUE_PSQT_BUCKETS];
+      for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) ps[b] -= prow[b];
+    }
   }
   slot->parent_code[j] = (ref_entry << 1) | (swap ? 1 : 0);
   return true;
@@ -168,14 +210,25 @@ void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out)
   // up to EVAL_BLOCK_MAX (search never exceeds one chunk in practice).
   for (int base = 0; base < n; base += EVAL_BLOCK_MAX) {
     int chunk = std::min(n - base, EVAL_BLOCK_MAX);
+    // ANCHOR PROTOCOL (the fused TPU kernel depends on it,
+    // ops/ft_gather.py): every delta entry references the MOST RECENT
+    // full entry preceding it — so the kernel reconstructs children
+    // from a single running anchor accumulator held in VMEM instead of
+    // a batch-wide gather. Entry 0 is always full; a failed delta
+    // (king moved, too many diffs) becomes full and the new anchor.
+    int last_full = 0;
     for (int j = 0; j < chunk; j++) {
       const Position& pos = positions[base + j];
-      // Entry 0 anchors the chunk with a full feature set; later entries
-      // are close relatives (the prefetcher ships a node with its
-      // children, or sibling evasions) and usually go out as deltas.
-      if (j == 0 || !fill_delta(slot_, j, positions[base], pos, 0))
-        fill_full(slot_, j, pos);
+      if (j == 0 || !fill_delta(slot_, net_, j, positions[base + last_full],
+                                pos, last_full)) {
+        fill_full(slot_, net_, j, pos);
+        last_full = j;
+      }
       slot_->buckets[j] = nnue_psqt_bucket(pos);
+      slot_->material[j] =
+          (slot_->psqt[j][0][slot_->buckets[j]] -
+           slot_->psqt[j][1][slot_->buckets[j]]) / 2;
+      slot_->entry_hash[j] = pos.hash;
     }
     slot_->block_n = chunk;
     slot_->wants_eval = true;
@@ -203,6 +256,8 @@ struct SearchPool {
   std::atomic<uint64_t> evals_shipped{0};  // eval slots across all steps
   std::atomic<uint64_t> suspensions{0};    // fiber blocks (1 round-trip each)
   std::atomic<uint64_t> step_capacity{0};  // sum of capacities (occupancy denom)
+  std::atomic<uint64_t> delta_evals{0};    // eval slots shipped as deltas
+  std::atomic<uint64_t> dedup_evals{0};    // requests served as aliases
   // Adaptive speculation budget (max speculative evals per prefetch
   // block). Halved whenever a step overflows capacity — wasted slots
   // then displace other fibers' demand evals — and grown back while
@@ -215,6 +270,18 @@ struct SearchPool {
   // TT evolution across backends; ROI experiments need fixed points).
   // Atomic: written from caller threads while the scheduler reads it.
   std::atomic<bool> prefetch_adaptive{true};
+  // ROI window state (scheduler thread only): speculation must EARN its
+  // batch slots. Every ROI_WINDOW non-empty steps the windowed hit rate
+  // is checked; unearned budgets halve to 0 and a periodic probe lets a
+  // workload whose consumption recovered re-earn it. Measured r2/r3:
+  // with a material-blind net the consumption sites (stand-pat windows,
+  // delta-pruned captures) almost never fire — ROI 0.0007 — and the
+  // wasted slots displaced demand evals 1:1 on a latency-priced link.
+  uint64_t roi_last_shipped = 0;
+  uint64_t roi_last_hits = 0;
+  uint64_t roi_check_step = 0;
+  uint64_t roi_probe_step = 0;
+  bool roi_ok = true;  // last window's verdict; gates budget growth
   std::unique_ptr<NnueNet> scalar_net;
   std::unique_ptr<ScalarEval> scalar_eval;
   // Whether the loaded net's eval tracks material (probed once at pool
@@ -230,6 +297,13 @@ struct SearchPool {
   // (slot id, index within the slot's block) per entry of the group's
   // last step() eval batch, in emission order.
   std::vector<std::vector<std::pair<int, int>>> group_batch;
+  // In-step dedup aliases per group: (slot, block entry, batch index of
+  // the identical position already emitted this step). Production
+  // batches analyze CONSECUTIVE PLIES of one game, so concurrent
+  // fibers walk overlapping trees in lockstep and request the same
+  // leaf in the same step — the TT only dedups across steps (the eval
+  // lands there after provide). One slot ships; provide() fans out.
+  std::vector<std::vector<std::tuple<int, int, int>>> group_alias;
   std::deque<int> finished_queue;
   // Round-robin scan origin per group: each step starts scanning just
   // past the last slot served, so over-capacity steps rotate service
@@ -244,6 +318,7 @@ struct SearchPool {
     for (auto& s : slots) s = std::make_unique<Slot>();
     n_groups = groups < 1 ? 1 : (groups > max_slots ? max_slots : groups);
     group_batch.resize(n_groups);
+    group_alias.resize(n_groups);
     group_cursor.assign(n_groups, 0);
   }
 };
@@ -322,6 +397,7 @@ int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
   slot.started = false;
   slot.finished = false;
   slot.wants_eval = false;
+  slot.alias_pending = false;
   slot.result = SearchResult();
   if (!slot.fiber) slot.fiber = std::make_unique<Fiber>(pool->fiber_stack);
   if (!slot.fiber->valid()) {
@@ -332,7 +408,8 @@ int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
     return -4;
   }
   if (!slot.bridge)
-    slot.bridge = std::make_unique<BatchedEval>(&slot, &pool->prefetch_budget);
+    slot.bridge = std::make_unique<BatchedEval>(
+        &slot, pool->scalar_net.get(), &pool->prefetch_budget);
   return id;
 }
 
@@ -371,11 +448,38 @@ namespace {
 // fits. Features go out as uint16 (22528 fits): half the bytes across
 // the host->device link, which is a scarce resource.
 bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
+                std::unordered_map<uint64_t, int>& seen,
+                std::vector<std::tuple<int, int, int>>& aliases,
                 int i, uint16_t* out_features, int32_t* out_buckets,
-                int32_t* out_slots, int32_t* out_parent, int capacity) {
+                int32_t* out_slots, int32_t* out_parent,
+                int32_t* out_material, int capacity, int align) {
   Slot& slot = *pool->slots[i];
   int base = int(batch.size());
+  // In-step dedup: a single-entry demand request whose position is
+  // already in this step's batch rides that entry instead of shipping
+  // a duplicate slot (same Zobrist key => same exact integer eval).
+  // Only singles alias: multi-entry blocks anchor the delta protocol
+  // by emission position, which aliasing entries would break.
+  if (slot.block_n == 1) {
+    auto it = seen.find(slot.entry_hash[0]);
+    if (it != seen.end()) {
+      pool->suspensions.fetch_add(1, std::memory_order_relaxed);
+      pool->dedup_evals.fetch_add(1, std::memory_order_relaxed);
+      slot.alias_pending = true;
+      aliases.emplace_back(i, 0, it->second);
+      return true;
+    }
+  }
   if (base + slot.block_n > capacity) return false;  // wait for next step
+  // Shard alignment (sharded serving): a block must not straddle an
+  // `align`-entry boundary, so every delta entry and its anchor land in
+  // the same mesh shard and the sharded eval needs NO cross-device
+  // gather (parallel/mesh.py ShardedEvaluator runs shard_map with
+  // shard-local parent codes). Smaller blocks from other fibers can
+  // still fill the gap this block skipped.
+  if (align > 0 && slot.block_n > 1 &&
+      base / align != (base + slot.block_n - 1) / align)
+    return false;
   // One fiber block served by this device round-trip.
   pool->suspensions.fetch_add(1, std::memory_order_relaxed);
   for (int j = 0; j < slot.block_n; j++) {
@@ -384,12 +488,18 @@ bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
            &slot.features[j][0][0], sizeof(uint16_t) * 2 * NNUE_MAX_ACTIVE);
     out_buckets[idx] = slot.buckets[j];
     out_slots[idx] = i;
+    out_material[idx] = slot.material[j];
     // Rebase delta references from block entries to batch positions
     // (the whole block ships in this batch, so the reference resolves
-    // within the same device call).
+    // within the same device call). Blocks are emitted contiguously, so
+    // the anchor protocol's "most recent preceding full entry"
+    // invariant carries over to batch indices unchanged.
     int32_t code = slot.parent_code[j];
     out_parent[idx] =
         code < 0 ? -1 : int32_t(((base + (code >> 1)) << 1) | (code & 1));
+    if (code >= 0)
+      pool->delta_evals.fetch_add(1, std::memory_order_relaxed);
+    seen.emplace(slot.entry_hash[j], idx);  // dedup target for later singles
     batch.emplace_back(i, j);
   }
   return true;
@@ -397,12 +507,22 @@ bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
 
 }  // namespace
 
+// `align` > 0 keeps every emitted block inside one align-entry span of
+// the batch (sharded serving passes the mesh shard size; 0 disables).
+// Callers must keep align >= EVAL_BLOCK_MAX or a maximal block could
+// never be placed.
 int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
                  int32_t* out_buckets, int32_t* out_slots,
-                 int32_t* out_parent, int capacity) {
+                 int32_t* out_parent, int32_t* out_material, int capacity,
+                 int align) {
   if (group < 0 || group >= pool->n_groups) group = 0;
   auto& batch = pool->group_batch[group];
+  auto& aliases = pool->group_alias[group];
   batch.clear();
+  aliases.clear();
+  // Position hash -> batch index emitted this step (dedup targets).
+  std::unordered_map<uint64_t, int> seen;
+  seen.reserve(size_t(capacity) * 2);
   const size_t n_slots = pool->slots.size();
   const int n_groups = pool->n_groups;
   size_t cursor = pool->group_cursor[group];
@@ -415,9 +535,12 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
     size_t i = (cursor + k) % n_slots;
     if (int(i) % n_groups != group) continue;
     Slot& slot = *pool->slots[i];
-    if (!slot.active || slot.finished || !slot.wants_eval) continue;
-    if (!emit_block(pool, batch, int(i), out_features, out_buckets, out_slots,
-                    out_parent, capacity))
+    if (!slot.active || slot.finished || !slot.wants_eval ||
+        slot.alias_pending)
+      continue;
+    if (!emit_block(pool, batch, seen, aliases, int(i), out_features,
+                    out_buckets, out_slots, out_parent, out_material,
+                    capacity, align))
       overflow = true;
   }
 
@@ -462,8 +585,9 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
     } else if (slot.wants_eval) {
       // Blocks that don't fit stay suspended; phase 1 of the next step
       // picks them up first.
-      if (!emit_block(pool, batch, int(i), out_features, out_buckets,
-                      out_slots, out_parent, capacity))
+      if (!emit_block(pool, batch, seen, aliases, int(i), out_features,
+                      out_buckets, out_slots, out_parent, out_material,
+                      capacity, align))
         overflow = true;
     }
   }
@@ -487,11 +611,50 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
       // CAS, not store: a concurrent fc_pool_set_prefetch pin must not
       // be clobbered by an AIMD update computed from the pre-pin value
       // (with adaptive then false, nothing would ever correct it).
+      // ROI gate, judged on a step window: speculative slots that are
+      // not being consumed (hits/shipped below threshold) displace
+      // other fibers' demand evals for nothing — the verdict gates
+      // growth and decays the budget all the way to 0. A zero budget
+      // ships no speculation, so ROI could never recover by itself:
+      // probe with a tiny budget every ROI_PROBE steps and let the next
+      // window's verdict re-zero or re-grow it. Measured r2/r3: with a
+      // material-blind net the consumption sites (stand-pat alpha
+      // windows, delta-pruned captures) almost never fire — ROI 0.0007
+      // while ~45% of shipped slots were speculative waste.
+      constexpr uint64_t ROI_WINDOW = 32, ROI_PROBE = 512;
+      constexpr uint64_t ROI_MIN_SAMPLE = 2048;
+      uint64_t step_now = pool->steps.load(std::memory_order_relaxed);
+      if (step_now - pool->roi_check_step >= ROI_WINDOW) {
+        uint64_t shipped =
+            pool->counters.prefetch_shipped.load(std::memory_order_relaxed);
+        uint64_t hits =
+            pool->counters.prefetch_hits.load(std::memory_order_relaxed);
+        uint64_t sd = shipped - pool->roi_last_shipped;
+        if (sd >= ROI_MIN_SAMPLE) {
+          pool->roi_ok = double(hits - pool->roi_last_hits) >= 0.05 * double(sd);
+          pool->roi_last_shipped = shipped;
+          pool->roi_last_hits = hits;
+          pool->roi_check_step = step_now;
+        }
+      }
       int budget = pool->prefetch_budget.load(std::memory_order_relaxed);
-      int next = overflow ? budget / 2
-                 : (int(batch.size()) * 2 < capacity && budget < EVAL_BLOCK_MAX)
-                     ? budget + 1
-                     : budget;
+      int next = budget;
+      if (!pool->roi_ok || overflow)
+        next = budget / 2;
+      else if (int(batch.size()) * 2 < capacity && budget < EVAL_BLOCK_MAX)
+        next = budget + 1;
+      if (budget == 0 && next == 0 &&
+          step_now - pool->roi_probe_step >= ROI_PROBE) {
+        next = 2;
+        pool->roi_ok = true;  // let the probe ship and be judged
+        pool->roi_probe_step = step_now;
+        // Restart the window so the probe's own shipments are judged.
+        pool->roi_last_shipped =
+            pool->counters.prefetch_shipped.load(std::memory_order_relaxed);
+        pool->roi_last_hits =
+            pool->counters.prefetch_hits.load(std::memory_order_relaxed);
+        pool->roi_check_step = step_now;
+      }
       if (next != budget)
         pool->prefetch_budget.compare_exchange_strong(
             budget, next, std::memory_order_relaxed);
@@ -506,9 +669,11 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
 // [4] demand evals                     [5] prefetched (speculative) evals
 // [6] prefetch hits                    [7] TT static-eval hits
 // [8] current prefetch budget (adaptive; instantaneous, not cumulative)
+// [9] eval slots shipped as incremental deltas (DMA-savings coverage)
+// [10] requests answered by in-step dedup (no slot shipped)
 int fc_pool_counters(SearchPool* pool, uint64_t* out, int n) {
   constexpr auto R = std::memory_order_relaxed;
-  const uint64_t vals[9] = {
+  const uint64_t vals[11] = {
       pool->steps.load(R),          pool->evals_shipped.load(R),
       pool->suspensions.load(R),    pool->step_capacity.load(R),
       pool->counters.demand_evals.load(R),
@@ -516,8 +681,10 @@ int fc_pool_counters(SearchPool* pool, uint64_t* out, int n) {
       pool->counters.prefetch_hits.load(R),
       pool->counters.tt_eval_hits.load(R),
       uint64_t(pool->prefetch_budget.load(R)),
+      pool->delta_evals.load(R),
+      pool->dedup_evals.load(R),
   };
-  int k = n < 9 ? n : 9;
+  int k = n < 11 ? n : 11;
   for (int i = 0; i < k; i++) out[i] = vals[i];
   return k;
 }
@@ -535,6 +702,21 @@ void fc_pool_provide(SearchPool* pool, int group, const int32_t* values, int n) 
     if (bidx == slot.block_n - 1) slot.wants_eval = false;  // runnable again
   }
   batch.clear();
+  // Fan the returned values out to deduplicated (aliased) requests.
+  for (auto& [sid, bidx, src] : pool->group_alias[group]) {
+    Slot& slot = *pool->slots[sid];
+    if (src >= n) {
+      // Partial provide dropped the alias target: release the alias so
+      // phase 1 re-emits the request next step (wants_eval stays set) —
+      // leaving alias_pending would strand the fiber forever.
+      slot.alias_pending = false;
+      continue;
+    }
+    slot.eval_values[bidx] = values[src];
+    slot.alias_pending = false;
+    if (bidx == slot.block_n - 1) slot.wants_eval = false;
+  }
+  pool->group_alias[group].clear();
 }
 
 // Number of slots still working (active and not finished).

@@ -321,6 +321,17 @@ def main(argv=None) -> int:
         return 0
     if opt.command == "configure":
         return 0  # dialog already ran inside parse_and_configure
+    if opt.command == "verify-net":
+        # One-command compatibility proof for a user-supplied real net
+        # (the reference embeds its net at build time, build.rs:7; no
+        # real net can exist offline here, so the proof is shipped
+        # instead — see fishnet_tpu/verify_net.py).
+        if not opt.nnue_file:
+            sys.stderr.write("E: verify-net requires --nnue-file PATH\n")
+            return 2
+        from fishnet_tpu.verify_net import run_cli
+
+        return run_cli(str(opt.nnue_file), verbose=opt.verbose)
     if opt.command == "uci":
         from fishnet_tpu.uci_server import serve
 

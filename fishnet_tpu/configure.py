@@ -151,7 +151,8 @@ def parse_toggle(s: str) -> Optional[bool]:
 # Opt
 # ---------------------------------------------------------------------------
 
-COMMANDS = ("run", "configure", "systemd", "systemd-user", "uci", "license")
+COMMANDS = ("run", "configure", "systemd", "systemd-user", "uci",
+            "verify-net", "license")
 
 ENGINE_BACKENDS = ("tpu-nnue", "az-mcts", "uci", "mock")
 
@@ -491,8 +492,9 @@ def parse_and_configure(
         ini = load_ini(opt.conf_path())
         file_found = opt.conf_path().exists()
         # The dialog triggers for bare invocations and `configure` only —
-        # never for `uci`, whose stdin belongs to the GUI's handshake.
-        if (not file_found and opt.command not in ("run", "uci")) or opt.command == "configure":
+        # never for `uci` (stdin belongs to the GUI's handshake) or the
+        # non-interactive `verify-net`.
+        if (not file_found and opt.command not in ("run", "uci", "verify-net")) or opt.command == "configure":
             if input_fn is None:
                 input_fn = lambda: sys.stdin.readline()
             output.write(INTRO)

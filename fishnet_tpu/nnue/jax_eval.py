@@ -69,6 +69,7 @@ def evaluate_batch(
     indices: jax.Array,
     buckets: jax.Array,
     parent: Optional[jax.Array] = None,
+    material: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Evaluate a batch. indices: integer [B, 2, 32] (stm perspective
     first, padded with NUM_FEATURES) — uint16 on the wire from the native
@@ -78,62 +79,65 @@ def evaluate_batch(
 
     ``parent`` (optional, int32 [B]) enables incremental evaluation:
     -1 marks a standalone full entry; code >= 0 means this entry's
-    indices are DELTAS (removals via spec.DELTA_BASE + i, the negated
-    table half) against batch entry ``code >> 1``'s accumulator, with
-    the perspectives swapped when ``code & 1`` (the sides to move
-    differ). Referenced entries must themselves be full — the native
-    pool guarantees every block's entry 0 is. Exact: integer adds
-    commute, so delta reconstruction is bit-identical to a full gather.
+    indices are DELTAS (removals via spec.DELTA_BASE + i) against batch
+    entry ``code >> 1``'s accumulator, with the perspectives swapped
+    when ``code & 1`` (the sides to move differ). The native pool
+    guarantees the referenced entry is the MOST RECENT preceding full
+    entry — the fused kernel's in-VMEM anchor resolution depends on it
+    (ops/ft_gather.py). Exact: integer adds commute, so delta
+    reconstruction is bit-identical to a full gather.
+
+    ``material`` (optional, int32 [B]): the bucket-selected PSQT
+    material term, precomputed HOST-side by the native pool during
+    feature extraction (cpp/src/pool.cpp fill_full/fill_delta — a ~60
+    load walk over an L2-resident 720 KB table there vs a random row
+    gather over an 11 MB padded table here). When given, the device
+    skips the whole PSQT path; when None (tests, training, schema-level
+    callers) PSQT is gathered on device as before.
     """
     indices = indices.astype(jnp.int32)
     # Feature transformer: fused Pallas gather-accumulate on TPU (single
-    # HBM pass per row), XLA take+sum elsewhere. [B, 2, L1] int32.
+    # HBM pass per row, incremental entries resolved against the running
+    # anchor), XLA take+sum elsewhere. [B, 2, L1] int32.
     from fishnet_tpu.ops.ft_gather import ft_accumulate
 
     if parent is None:
         # Full entries only: no removal encodings can appear, so skip
         # the decode arithmetic entirely in this trace.
         acc = ft_accumulate(params["ft_w"], params["ft_b"], indices)
-        psqt_rows = jnp.take(params["ft_psqt"], indices, axis=0)
-        psqt = jnp.sum(psqt_rows, axis=2)  # [B, 2, 8] int32
     else:
         acc = ft_accumulate(
             params["ft_w"],
             params["ft_b"],
             indices,
             delta_base=spec.DELTA_BASE,
-            sparse=parent >= 0,
+            parent=parent,
         )
-        # PSQT accumulators, honoring removal encodings (DELTA_BASE + f
-        # subtracts feature f's row; its pad decodes to the sentinel).
-        is_rem = indices >= spec.DELTA_BASE
-        base_idx = jnp.where(is_rem, indices - spec.DELTA_BASE, indices)
-        sign = jnp.where(is_rem, -1, 1)
-        psqt_rows = jnp.take(params["ft_psqt"], base_idx, axis=0)
-        psqt = jnp.sum(psqt_rows * sign[..., None], axis=2)  # [B, 2, 8]
 
-    if parent is not None:
-        parent = parent.astype(jnp.int32)
-        valid = parent >= 0
-        ref = jnp.where(valid, parent >> 1, 0)
-        swap = (parent & 1).astype(bool)
-        # Gather the referenced (full) accumulators; swap perspectives
-        # where the child's side to move flipped relative to its parent.
-        perm = jnp.where(
-            swap[:, None], jnp.array([1, 0]), jnp.array([0, 1])
-        )  # [B, 2]
-        ref_acc = jnp.take_along_axis(
-            jnp.take(acc, ref, axis=0), perm[:, :, None], axis=1
-        )
-        ref_psqt = jnp.take_along_axis(
-            jnp.take(psqt, ref, axis=0), perm[:, :, None], axis=1
-        )
-        # The delta entry's own partial already includes the bias once;
-        # subtract the copy that rides in with the parent accumulator.
-        acc = jnp.where(
-            valid[:, None, None], acc + ref_acc - params["ft_b"], acc
-        )
-        psqt = jnp.where(valid[:, None, None], psqt + ref_psqt, psqt)
+    if material is None:
+        if parent is None:
+            psqt_rows = jnp.take(params["ft_psqt"], indices, axis=0)
+            psqt = jnp.sum(psqt_rows, axis=2)  # [B, 2, 8] int32
+        else:
+            # PSQT accumulators, honoring removal encodings (DELTA_BASE
+            # + f subtracts feature f's row; its pad decodes to the
+            # sentinel), then resolved against the referenced entries.
+            is_rem = indices >= spec.DELTA_BASE
+            base_idx = jnp.where(is_rem, indices - spec.DELTA_BASE, indices)
+            sign = jnp.where(is_rem, -1, 1)
+            psqt_rows = jnp.take(params["ft_psqt"], base_idx, axis=0)
+            psqt = jnp.sum(psqt_rows * sign[..., None], axis=2)  # [B, 2, 8]
+            parent = parent.astype(jnp.int32)
+            valid = parent >= 0
+            ref = jnp.where(valid, parent >> 1, 0)
+            swap = (parent & 1).astype(bool)
+            perm = jnp.where(
+                swap[:, None], jnp.array([1, 0]), jnp.array([0, 1])
+            )  # [B, 2]
+            ref_psqt = jnp.take_along_axis(
+                jnp.take(psqt, ref, axis=0), perm[:, :, None], axis=1
+            )
+            psqt = jnp.where(valid[:, None, None], psqt + ref_psqt, psqt)
 
     # Clipped pairwise multiply; stm half first.
     c = jnp.clip(acc, 0, spec.FT_CLIP)
@@ -185,10 +189,13 @@ def evaluate_batch(
     )  # [B, 8, 1]
     v = jnp.take_along_axis(v_all, buckets[:, None, None], axis=1)[:, 0, 0]
 
-    psqt_sel = jnp.take_along_axis(
-        psqt, jnp.repeat(buckets[:, None, None], 2, axis=1), axis=2
-    )[..., 0]
-    material = _trunc_div(psqt_sel[:, 0] - psqt_sel[:, 1], 2)
+    if material is None:
+        psqt_sel = jnp.take_along_axis(
+            psqt, jnp.repeat(buckets[:, None, None], 2, axis=1), axis=2
+        )[..., 0]
+        material = _trunc_div(psqt_sel[:, 0] - psqt_sel[:, 1], 2)
+    else:
+        material = material.astype(jnp.int32)
     positional = v + skip + _trunc_div(skip * 23, 127)
     return _trunc_div(positional + material, spec.FV_SCALE)
 

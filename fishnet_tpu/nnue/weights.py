@@ -107,7 +107,14 @@ class NnueWeights:
             nonlocal off
             chunk = data[off : off + n]
             if len(chunk) != n:
-                raise ValueError("truncated nnue file")
+                raise ValueError(
+                    "truncated nnue file (wanted "
+                    f"{n} bytes at offset {off}, {len(data) - off} left). "
+                    "Note: nets saved by pre-r2 builds of this framework "
+                    "used unpadded l2 rows and are exactly 512 bytes/stack "
+                    "short of the SF/nnue-pytorch layout — re-export them "
+                    "with the current build."
+                )
             off += n
             return chunk
 

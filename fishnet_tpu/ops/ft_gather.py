@@ -14,6 +14,16 @@ are data-dependent, which is exactly what PrefetchScalarGridSpec's
 scalar-prefetched index argument enables: the indices are available
 before the kernel body, so the DMAs can be issued immediately.
 
+Incremental (delta) entries are RESOLVED in-kernel (round 3): the native
+pool guarantees every delta entry references the most recent preceding
+FULL entry of the same batch (cpp/src/pool.cpp evaluate_block's anchor
+protocol), so the kernel keeps one running "anchor" accumulator in VMEM
+scratch — full entries refresh it, delta entries add their few delta
+rows to it (perspective-swapped when the sides to move differ). Round 2
+instead shipped partial accumulators and resolved references with a
+batch-wide XLA gather over [B, 2, L1] int32 — a full extra HBM pass
+(~2 ms per 16k batch) that this design deletes outright.
+
 Used by jax_eval.evaluate_batch on TPU backends; the plain XLA path
 remains the fallback (CPU tests, odd shapes) and the parity test runs
 this kernel in interpreter mode against it.
@@ -52,6 +62,27 @@ def _xla_ft_accumulate(
     return ft_b.astype(jnp.int32) + jnp.sum(rows, axis=2)
 
 
+def _xla_resolve_parents(
+    acc: jax.Array, ft_b: jax.Array, parent: jax.Array
+) -> jax.Array:
+    """Resolve incremental entries of an XLA-partials accumulator batch:
+    parent int32 [B], -1 full, else (ref << 1) | swap with ref a batch
+    index of a FULL entry. Exact: integer adds commute, so delta partial
+    + referenced accumulator - (the doubly counted) bias is bit-identical
+    to a full gather."""
+    parent = parent.astype(jnp.int32)
+    valid = parent >= 0
+    ref = jnp.where(valid, parent >> 1, 0)
+    swap = (parent & 1).astype(bool)
+    perm = jnp.where(swap[:, None], jnp.array([1, 0]), jnp.array([0, 1]))
+    ref_acc = jnp.take_along_axis(
+        jnp.take(acc, ref, axis=0), perm[:, :, None], axis=1
+    )
+    return jnp.where(
+        valid[:, None, None], acc + ref_acc - ft_b.astype(jnp.int32), acc
+    )
+
+
 #: Slot budget of the SPARSE mode, per perspective: incremental (delta)
 #: entries carry up to DELTA_SLOTS added rows in slots [0, DELTA_SLOTS)
 #: and up to DELTA_SLOTS removed rows (encoded delta_base + f) in slots
@@ -68,8 +99,8 @@ def _xla_ft_accumulate(
 _SPARSE_SLOTS = 2 * _DELTA_SLOTS
 
 
-def _kernel(idx_ref, sparse_ref, ft_ref, bias_ref, out_ref, rows, sems, *,
-            delta_base):
+def _kernel(idx_ref, flags_ref, ft_ref, bias_ref, carry_ref, out_ref, rows,
+            sems, anchor, *, delta_base, anchored):
     # Software-pipelined gather: scratch holds TWO positions' rows. Grid
     # step b waits on the buffer its predecessor filled for it, issues
     # position b+1's row DMAs into the other buffer, then reduces — so
@@ -77,12 +108,13 @@ def _kernel(idx_ref, sparse_ref, ft_ref, bias_ref, out_ref, rows, sems, *,
     # drains between positions. Row addresses come from the scalar-
     # prefetched index operand, available before the body runs.
     #
-    # Per-position mode, a pure function of the scalar-prefetched sparse
-    # flags (so the issuing step for b+1 and the waiting step at b+1
-    # always agree): sparse (incremental/delta) entries touch only
-    # _SPARSE_SLOTS slots per perspective — removal slots' indices are
-    # decoded by subtracting delta_base — while dense entries fetch all
-    # slots as plain additions.
+    # Per-position flags (scalar-prefetched, so the issuing step for b+1
+    # and the waiting step at b+1 always agree): bit 0 = sparse
+    # (incremental/delta) entry touching only _SPARSE_SLOTS slots per
+    # perspective with removal slots decoded by subtracting delta_base;
+    # bit 1 (anchored mode) = the entry's perspectives are swapped
+    # relative to its anchor. Dense entries fetch all slots as plain
+    # additions.
     b = pl.program_id(0)
     n = pl.num_programs(0)
     n_active = rows.shape[1] // 2  # both perspectives share a buffer
@@ -108,7 +140,7 @@ def _kernel(idx_ref, sparse_ref, ft_ref, bias_ref, out_ref, rows, sems, *,
         if delta_base is None:
             fn(n_active, False)
             return
-        sparse = sparse_ref[pos] != 0
+        sparse = (flags_ref[pos] & 1) != 0
 
         @pl.when(sparse)
         def _():
@@ -123,6 +155,11 @@ def _kernel(idx_ref, sparse_ref, ft_ref, bias_ref, out_ref, rows, sems, *,
     @pl.when(b == 0)
     def _():
         both_modes(0, lambda lim, sp: transfer(0, 0, True, lim, sp))
+        if anchored:
+            # Chunk carry-in: the anchor as of the end of the previous
+            # chunk (zeros for the first — the pool guarantees batch
+            # entry 0 is full, so it is never read there).
+            anchor[...] = carry_ref[...]
 
     @pl.when(b + 1 < n)
     def _():
@@ -131,29 +168,63 @@ def _kernel(idx_ref, sparse_ref, ft_ref, bias_ref, out_ref, rows, sems, *,
 
     both_modes(b, lambda lim, sp: transfer(b, slot, False, lim, sp))
 
-    bias = bias_ref[:].astype(jnp.int32)
+    bias = bias_ref[...].astype(jnp.int32)
 
-    def reduce(limit, is_sparse):
+    def reduce_full(limit):
         # jnp.sum (tree reduction), not a serial add chain.
         for p in range(2):
             base = p * n_active
-            if is_sparse:
-                adds = jnp.sum(
-                    rows[slot, base : base + _DELTA_SLOTS].astype(jnp.int32),
-                    axis=0,
-                )
-                rems = jnp.sum(
-                    rows[slot, base + _DELTA_SLOTS : base + _SPARSE_SLOTS]
-                    .astype(jnp.int32),
-                    axis=0,
-                )
-                out_ref[0, p] = bias + adds - rems
-            else:
-                out_ref[0, p] = bias + jnp.sum(
-                    rows[slot, base : base + limit].astype(jnp.int32), axis=0
-                )
+            acc = bias + jnp.sum(
+                rows[slot, base : base + limit].astype(jnp.int32), axis=0
+            )
+            out_ref[0, p] = acc
+            if anchored:
+                anchor[p] = acc
 
-    both_modes(b, reduce)
+    def reduce_sparse():
+        partial = []
+        for p in range(2):
+            base = p * n_active
+            adds = jnp.sum(
+                rows[slot, base : base + _DELTA_SLOTS].astype(jnp.int32),
+                axis=0,
+            )
+            rems = jnp.sum(
+                rows[slot, base + _DELTA_SLOTS : base + _SPARSE_SLOTS]
+                .astype(jnp.int32),
+                axis=0,
+            )
+            partial.append(adds - rems)
+        if not anchored:
+            for p in range(2):
+                out_ref[0, p] = bias + partial[p]
+            return
+        # Resolve against the running anchor (the most recent full
+        # entry): bit 1 says whether the perspectives are swapped.
+        swap = (flags_ref[b] & 2) != 0
+
+        @pl.when(swap)
+        def _():
+            for p in range(2):
+                out_ref[0, p] = anchor[1 - p] + partial[p]
+
+        @pl.when(jnp.logical_not(swap))
+        def _():
+            for p in range(2):
+                out_ref[0, p] = anchor[p] + partial[p]
+
+    if delta_base is None:
+        reduce_full(n_active)
+    else:
+        sparse = (flags_ref[b] & 1) != 0
+
+        @pl.when(sparse)
+        def _():
+            reduce_sparse()
+
+        @pl.when(jnp.logical_not(sparse))
+        def _():
+            reduce_full(n_active)
 
 
 # Positions per pallas_call: the scalar-prefetch index operand lives in
@@ -165,14 +236,17 @@ def _kernel(idx_ref, sparse_ref, ft_ref, bias_ref, out_ref, rows, sems, *,
 _CHUNK = 512
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "delta_base"))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "delta_base", "anchored")
+)
 def _pallas_ft_accumulate(
     ft_w: jax.Array,
     ft_b: jax.Array,
     indices: jax.Array,
-    sparse: Optional[jax.Array] = None,
+    flags: Optional[jax.Array] = None,
     interpret: bool = False,
     delta_base: int | None = None,
+    anchored: bool = False,
 ) -> jax.Array:
     batch, persp, n_active = indices.shape
     l1 = ft_w.shape[1]
@@ -185,40 +259,55 @@ def _pallas_ft_accumulate(
     ft_tiles = ft_w.reshape(ft_w.shape[0], sub, 128)
     bias_tile = ft_b.reshape(sub, 128)
 
-    def run_chunk(idx_chunk: jax.Array, sparse_chunk: jax.Array) -> jax.Array:
+    def run_chunk(idx_chunk, flags_chunk, carry):
         chunk = idx_chunk.shape[0]
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,  # indices + per-position sparse flags
+            num_scalar_prefetch=2,  # indices + per-position flags
             grid=(chunk,),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.ANY),  # ft_w stays in HBM
                 pl.BlockSpec(memory_space=pltpu.VMEM),  # bias
+                pl.BlockSpec(memory_space=pltpu.VMEM),  # anchor carry-in
             ],
             out_specs=pl.BlockSpec(
-                (1, 2, sub, 128), lambda b, idx_ref, sparse_ref: (b, 0, 0, 0)
+                (1, 2, sub, 128), lambda b, idx_ref, flags_ref: (b, 0, 0, 0)
             ),
             scratch_shapes=[
                 pltpu.VMEM((2, 2 * n_active, sub, 128), ft_w.dtype),
                 pltpu.SemaphoreType.DMA((2, 2 * n_active)),
+                pltpu.VMEM((2, sub, 128), jnp.int32),  # running anchor
             ],
         )
         return pl.pallas_call(
-            functools.partial(_kernel, delta_base=delta_base),
+            functools.partial(_kernel, delta_base=delta_base,
+                              anchored=anchored),
             out_shape=jax.ShapeDtypeStruct((chunk, 2, sub, 128), jnp.int32),
             grid_spec=grid_spec,
             interpret=interpret,
-        )(idx_chunk, sparse_chunk, ft_tiles, bias_tile)
+        )(idx_chunk, flags_chunk, ft_tiles, bias_tile, carry)
 
     idx = indices.astype(jnp.int32)
-    flags = (
-        jnp.zeros((batch,), jnp.int32)
-        if sparse is None
-        else sparse.astype(jnp.int32)
-    )
-    outs = [
-        run_chunk(idx[start : start + _CHUNK], flags[start : start + _CHUNK])
-        for start in range(0, batch, _CHUNK)
-    ]
+    if flags is None:
+        flags = jnp.zeros((batch,), jnp.int32)
+    else:
+        flags = flags.astype(jnp.int32)
+    carry = jnp.zeros((2, sub, 128), jnp.int32)
+    outs = []
+    for start in range(0, batch, _CHUNK):
+        idx_c = idx[start : start + _CHUNK]
+        fl_c = flags[start : start + _CHUNK]
+        out = run_chunk(idx_c, fl_c, carry)
+        outs.append(out)
+        if anchored and start + _CHUNK < batch:
+            # Next chunk's carry-in: the accumulator of the last FULL
+            # entry so far (an anchor referenced across a chunk edge is
+            # by protocol the most recent full entry of the batch).
+            is_full = (fl_c & 1) == 0
+            has_full = jnp.any(is_full)
+            last_full = (
+                idx_c.shape[0] - 1 - jnp.argmax(is_full[::-1]).astype(jnp.int32)
+            )
+            carry = jnp.where(has_full, jnp.take(out, last_full, axis=0), carry)
     out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
     return out.reshape(batch, persp, l1)
 
@@ -232,28 +321,53 @@ def ft_accumulate(
     interpret: bool = False,
     delta_base: int | None = None,
     sparse: Optional[jax.Array] = None,
+    parent: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Feature-transformer accumulators, bias included: int32 [B, 2, L1].
 
     ``ft_w`` [rows, L1] int16 whose LAST row is the zero sentinel;
     ``ft_b`` [L1] int16; ``indices`` integer [B, 2, MAX_ACTIVE] padded
-    with the sentinel index. With ``delta_base`` set, rows flagged by
-    ``sparse`` (bool [B]) are incremental (delta) entries following the
-    spec.DELTA_SLOTS wire contract: adds in the first slots, removals
-    (encoded delta_base + f) after them — the fused kernel fetches only
-    those few slots and subtracts the removal rows, which is where
-    incremental eval's DMA savings land. ``use_pallas=None``
-    auto-selects: the fused kernel on TPU backends when shapes conform
-    (lane-aligned L1), XLA otherwise.
+    with the sentinel index. With ``delta_base`` set, incremental
+    (delta) entries follow the spec.DELTA_SLOTS wire contract: adds in
+    the first slots, removals (encoded delta_base + f) after them — the
+    fused kernel fetches only those few slots and subtracts the removal
+    rows, which is where incremental eval's DMA savings land.
+
+    Two incremental modes:
+
+    * ``parent`` given (int32 [B]; -1 = full, else (ref << 1) | swap):
+      delta entries are RESOLVED — the result is every entry's complete
+      accumulator. The fused kernel resolves from a running in-VMEM
+      anchor, relying on the pool's guarantee that ref is always the
+      most recent preceding full entry; the XLA fallback gathers by the
+      explicit ref index. Bit-identical either way.
+    * ``sparse`` given (bool [B]) without ``parent``: delta entries come
+      back as bias-included PARTIALS (adds - removes); the caller owns
+      resolution. (Kept for tests and schema-level users.)
+
+    ``use_pallas=None`` auto-selects: the fused kernel on TPU backends
+    when shapes conform (lane-aligned L1), XLA otherwise.
     """
     indices = indices.astype(jnp.int32)
     if use_pallas is None:
         use_pallas = (
             jax.default_backend() == "tpu" and ft_w.shape[1] % 1024 == 0
         )
+    if parent is not None:
+        parent = parent.astype(jnp.int32)
+        if use_pallas or interpret:
+            # bit 0: sparse; bit 1: perspective swap vs the anchor.
+            flags = jnp.where(parent >= 0, 1 | ((parent & 1) << 1), 0)
+            return _pallas_ft_accumulate(
+                ft_w, ft_b, indices, flags,
+                interpret=interpret, delta_base=delta_base, anchored=True,
+            )
+        acc = _xla_ft_accumulate(ft_w, ft_b, indices, delta_base=delta_base)
+        return _xla_resolve_parents(acc, ft_b, parent)
     if use_pallas or interpret:
+        flags = None if sparse is None else sparse.astype(jnp.int32)
         return _pallas_ft_accumulate(
-            ft_w, ft_b, indices, sparse,
+            ft_w, ft_b, indices, flags,
             interpret=interpret, delta_base=delta_base,
         )
     return _xla_ft_accumulate(ft_w, ft_b, indices, delta_base=delta_base)

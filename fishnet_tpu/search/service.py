@@ -78,7 +78,7 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
     ]
     lib.fc_pool_step.restype = ctypes.c_int
     lib.fc_pool_provide.argtypes = [
@@ -216,10 +216,14 @@ class SearchService:
         self.backend = backend
         # Every batch shipped to a sharded evaluator must split evenly
         # across its devices; force capacities and size buckets to
-        # multiples of the evaluator's shard count.
+        # multiples of the evaluator's shard count. Sharded mode also
+        # needs every SHARD to hold at least one maximal eval block
+        # (emit_block never splits a block across a shard boundary —
+        # the no-cross-shard-gather invariant — so a shard smaller than
+        # EVAL_BLOCK_MAX could never place one).
         mult = max(1, int(getattr(evaluator, "size_multiple", 1)))
         self.batch_capacity = batch_capacity = _round_up(
-            max(batch_capacity, MIN_BATCH_CAPACITY), mult
+            max(batch_capacity, MIN_BATCH_CAPACITY * mult), mult
         )
         # Pipeline depth: the pool's slots are partitioned into this many
         # groups, each with its own in-flight device batch. While group
@@ -267,24 +271,36 @@ class SearchService:
         # together still fill one batch_capacity of in-flight work —
         # without this, k groups each padding up to the full capacity
         # bucket would multiply the host->device bytes by k.
+        # In sharded mode each group's SHARD (group_capacity / mult) must
+        # still hold one maximal eval block, or aligned emission could
+        # never place it (cpp/src/pool.cpp fc_pool_step align contract)
+        # — hence the MIN * mult floor after the pipeline-depth split.
         self._group_capacity = _round_up(
-            max(MIN_BATCH_CAPACITY, cap // self.pipeline_depth), mult
+            max(MIN_BATCH_CAPACITY * mult, cap // self.pipeline_depth), mult
         )
         # Shape buckets for _evaluate. Each distinct size is one XLA
         # compile (slow through a device tunnel) — callers with a known
         # steady-state load should pass just two or three sizes.
-        if eval_sizes is not None:
-            sizes = {min(int(s), cap) for s in eval_sizes if s > 0}
+        # SHARDED mode uses exactly one bucket (the group capacity):
+        # block emission is aligned to the shard size of the shipped
+        # batch, and only a single static size keeps that alignment a
+        # constant the pool can honor.
+        if mult > 1:
+            self._eval_sizes = [self._group_capacity]
+            self._shard_align = self._group_capacity // mult
         else:
-            sizes = set()
-            s = 64
-            while s < cap:
-                sizes.add(s)
-                s *= 2
-        sizes.add(cap)
-        sizes.add(self._group_capacity)  # groups fill to this bucket
-        # Shard-align every bucket (no-op for the single-device path).
-        self._eval_sizes = sorted({min(_round_up(s, mult), cap) for s in sizes})
+            if eval_sizes is not None:
+                sizes = {min(int(s), cap) for s in eval_sizes if s > 0}
+            else:
+                sizes = set()
+                s = 64
+                while s < cap:
+                    sizes.add(s)
+                    s *= 2
+            sizes.add(cap)
+            sizes.add(self._group_capacity)  # groups fill to this bucket
+            self._eval_sizes = sorted({min(s, cap) for s in sizes})
+            self._shard_align = 0
         # uint16 feature indices: half the host->device transfer bytes.
         # One buffer set per pipeline group: group i's buffers must stay
         # untouched while its dispatched eval is still in flight.
@@ -295,6 +311,16 @@ class SearchService:
         # Incremental-eval references (batch-relative parent codes; -1 =
         # full entry) emitted by the pool alongside the features.
         self._parent_buf = np.empty((k, cap), dtype=np.int32)
+        # Host-computed material term (bucket-selected PSQT difference,
+        # cpp fill_full/fill_delta): 4 bytes/position on the wire buys
+        # the device out of the whole PSQT gather.
+        self._material_buf = np.empty((k, cap), dtype=np.int32)
+        # Shipped-bucket accounting (driver thread writes, telemetry
+        # reads; int += is GIL-atomic): occupancy against the bucket
+        # actually transferred, not the configured capacity — a lightly
+        # loaded step that ships the 1k bucket is not "5% occupied".
+        self._eval_steps = 0
+        self._bucket_slots = 0
         self._pending: Dict[int, _Pending] = {}
         self._submissions: List[Tuple] = []
         self._stop_requests: List[Tuple[int, _Pending]] = []
@@ -366,7 +392,10 @@ class SearchService:
                 )
                 bucks = np.zeros((s,), np.int32)
                 parents = np.full((s,), -1, np.int32)
-                np.asarray(self._eval_fn(self._params, feats, bucks, parents))
+                material = np.zeros((s,), np.int32)
+                np.asarray(
+                    self._eval_fn(self._params, feats, bucks, parents, material)
+                )
             self._warmed = True
 
     def poke(self) -> None:
@@ -387,14 +416,18 @@ class SearchService:
         the measurements behind occupancy / prefetch-ROI / cache-rate
         (see cpp SearchCounters). Safe to read at any time; values are
         monotone and single-writer."""
-        buf = (ctypes.c_uint64 * 9)()
-        n = self._lib.fc_pool_counters(self._pool, buf, 9)
-        keys = (
+        buf = (ctypes.c_uint64 * 11)()
+        n = self._lib.fc_pool_counters(self._pool, buf, 11)
+        out = {k: int(buf[i]) for i, k in enumerate((
             "steps", "evals_shipped", "suspensions", "step_capacity",
             "demand_evals", "prefetch_shipped", "prefetch_hits",
-            "tt_eval_hits", "prefetch_budget",
-        )
-        return {k: int(buf[i]) for i, k in enumerate(keys[:n])}
+            "tt_eval_hits", "prefetch_budget", "delta_evals",
+            "dedup_evals",
+        )[:n])}
+        # Service-side: slots actually transferred (size-bucketed).
+        out["eval_steps"] = self._eval_steps
+        out["bucket_slots"] = self._bucket_slots
+        return out
 
     def is_alive(self) -> bool:
         """False once the service is shut down or its driver crashed —
@@ -459,14 +492,19 @@ class SearchService:
             if n <= s:
                 size = s
                 break
+        self._eval_steps += 1
+        self._bucket_slots += size
         feats = self._feat_buf[group]
         buckets = self._bucket_buf[group]
         parents = self._parent_buf[group]
+        material = self._material_buf[group]
         feats[n:size] = spec.NUM_FEATURES
         buckets[n:size] = 0
         parents[n:size] = -1
+        material[n:size] = 0
         return self._eval_fn(
-            self._params, feats[:size], buckets[:size], parents[:size]
+            self._params, feats[:size], buckets[:size], parents[:size],
+            material[:size],
         )
 
     def _resolve_eval(self, n: int, arr) -> np.ndarray:
@@ -502,6 +540,10 @@ class SearchService:
         ]
         parent_ptrs = [
             self._parent_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            for g in range(k)
+        ]
+        material_ptrs = [
+            self._material_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
             for g in range(k)
         ]
         # In-flight device evals per group: group -> (n, dispatched array).
@@ -588,7 +630,8 @@ class SearchService:
                 # Advance this group's fibers; fill its eval batch.
                 n = lib.fc_pool_step(
                     self._pool, g, feat_ptrs[g], bucket_ptrs[g], slot_ptrs[g],
-                    parent_ptrs[g], self._group_capacity,
+                    parent_ptrs[g], material_ptrs[g], self._group_capacity,
+                    self._shard_align,
                 )
                 stepped += n
                 if n > 0:

@@ -268,3 +268,31 @@ def test_nnue_golden_byte_layout(tmp_path):
         )[0]
     )
     assert oracle.evaluate(board) == jax_score
+
+
+def test_verify_net_subcommand(tmp_path):
+    """`fishnet-tpu verify-net --nnue-file X` (fishnet_tpu/verify_net.py)
+    is the offline-maximum answer to the real-net gap (the reference
+    embeds its net at build time, build.rs:7): every stage — layout,
+    scalar load, scalar-vs-JAX bit parity, fixed-depth search parity,
+    material probe — must pass against a generated net, and a corrupted
+    file must fail the layout stage with the re-export hint."""
+    from fishnet_tpu.verify_net import verify_net
+
+    path = tmp_path / "net.nnue"
+    NnueWeights.random(seed=13).save(path)
+    lines = []
+    assert verify_net(str(path), positions=40, depth=2, log=lines.append)
+    report = "\n".join(lines)
+    assert "layout          PASS" in report
+    assert "eval parity     PASS" in report
+    assert "search parity   PASS" in report
+    assert "material probe" in report
+
+    # Truncation fails stage 1 and mentions the pre-r2 re-export hint.
+    data = path.read_bytes()
+    bad = tmp_path / "short.nnue"
+    bad.write_bytes(data[: len(data) - 512])
+    lines = []
+    assert not verify_net(str(bad), positions=5, depth=1, log=lines.append)
+    assert any("FAIL" in l and "re-export" in l for l in lines)

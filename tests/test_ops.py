@@ -75,6 +75,71 @@ def test_pallas_chunking_boundaries(batch):
     assert np.array_equal(ref, got)
 
 
+def _block_batch(n_features, active, n_blocks, block, rng):
+    """Anchor-protocol batch: each block is one full entry followed by
+    delta children referencing it (the most recent preceding full
+    entry), with random perspective swaps — the shape the native pool
+    emits (cpp/src/pool.cpp evaluate_block)."""
+    from fishnet_tpu.ops.ft_gather import _DELTA_SLOTS
+
+    delta_base = n_features + 1
+    batch = n_blocks * block
+    idx = np.full((batch, 2, active), n_features, np.int32)
+    parent = np.full((batch,), -1, np.int32)
+    for s in range(0, batch, block):
+        idx[s, :, : active - 3] = rng.integers(0, n_features, (2, active - 3))
+        for j in range(1, block):
+            e = s + j
+            swap = int(rng.integers(0, 2))
+            parent[e] = (s << 1) | swap
+            for p in range(2):
+                n_add = int(rng.integers(0, _DELTA_SLOTS + 1))
+                n_rem = int(rng.integers(0, _DELTA_SLOTS + 1))
+                idx[e, p, :n_add] = rng.integers(0, n_features, n_add)
+                idx[e, p, _DELTA_SLOTS : _DELTA_SLOTS + n_rem] = (
+                    delta_base + rng.integers(0, n_features, n_rem)
+                )
+                idx[e, p, _DELTA_SLOTS + n_rem : 2 * _DELTA_SLOTS] = (
+                    delta_base + n_features
+                )
+    return jnp.asarray(idx), jnp.asarray(parent), delta_base
+
+
+def test_pallas_anchored_resolution_interpret(monkeypatch):
+    """Anchored (in-VMEM running anchor) delta resolution must agree
+    bit-exactly with the XLA explicit-index fallback, including across
+    pallas-call chunk boundaries (the carry-in path): shrink _CHUNK so
+    blocks straddle chunks and children must resolve against an anchor
+    computed by the PREVIOUS pallas call."""
+    from fishnet_tpu.ops import ft_gather
+
+    monkeypatch.setattr(ft_gather, "_CHUNK", 8)
+    n_features, l1, active = 512, 1024, 32
+    rng = np.random.default_rng(11)
+    ft_w = jnp.asarray(
+        np.vstack(
+            [rng.integers(-200, 200, (n_features, l1)), np.zeros((1, l1))]
+        ).astype(np.int16)
+    )
+    ft_b = jnp.asarray(rng.integers(-100, 100, (l1,)).astype(np.int16))
+    # Blocks of 5 against chunks of 8: entries 8-9 (etc.) are deltas
+    # whose anchor lives in the previous chunk.
+    idx, parent, delta_base = _block_batch(n_features, active, 4, 5, rng)
+    ref = np.asarray(
+        ft_gather.ft_accumulate(
+            ft_w, ft_b, idx, use_pallas=False,
+            delta_base=delta_base, parent=parent,
+        )
+    )
+    got = np.asarray(
+        ft_gather.ft_accumulate(
+            ft_w, ft_b, idx, interpret=True,
+            delta_base=delta_base, parent=parent,
+        )
+    )
+    assert np.array_equal(ref, got)
+
+
 def test_pallas_sparse_delta_mode_interpret():
     """The kernel's SPARSE mode (mode-predicated transfers, removal-slot
     index decode, adds-minus-removes reduce) must agree with the XLA
