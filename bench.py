@@ -54,7 +54,14 @@ import time
 
 REFERENCE_BASELINE_NPS = 60 * 2_000_000 / 35.0  # top-end fishnet client
 
-CONCURRENT_BATCHES = 64
+#: 128 concurrent analysis batches: the fiber pool's "cores" analogue.
+#: Measured (r3, 60 s probes on the tunnel): doubling the in-flight
+#: population from 3840 to 7680 raised nodes/step 8.7k -> 14.3k and
+#: batch occupancy 0.60 -> 0.82 at equal tunnel nps (the link is
+#: payload-priced, so bigger steps cost proportionally more there —
+#: on locally attached chips, where the payload term vanishes, the
+#: bigger step is strictly better).
+CONCURRENT_BATCHES = 128
 POSITIONS_PER_BATCH = 60
 NODES_PER_SEARCH = 4_000
 #: Measurement window. Tunnel round-trip latency varies several-fold run
@@ -66,7 +73,9 @@ NODES_PER_SEARCH = 4_000
 #: which takes tens of seconds of round-trips when the tunnel is slow)
 #: plus compiles, keeping the whole bench inside a 10-minute budget even
 #: in bad tunnel weather.
-BENCH_SECONDS = 180.0
+import os as _os
+
+BENCH_SECONDS = float(_os.environ.get("FISHNET_BENCH_SECONDS", 180.0))
 
 
 def log(msg: str) -> None:
@@ -365,6 +374,7 @@ async def run_searches(service, jobs, nodes: int,
     ones, src/queue.rs) so the measured window sees steady-state
     concurrency, not the ramp-down tail of one submission wave."""
     stop_event = threading.Event() if deadline_seconds else None
+    at_deadline = {}
 
     async def one(fen, moves):
         r = await service.search(root_fen=fen, moves=moves, nodes=nodes,
@@ -375,8 +385,20 @@ async def run_searches(service, jobs, nodes: int,
     if stop_event is not None:
         async def fire():
             await asyncio.sleep(deadline_seconds)
+            # Snapshot the pool counters AT the deadline: the windowed
+            # steady-state rate comes from here (the live `nodes`
+            # counter), so the drain below cannot dilute it.
+            at_deadline.update(service.counters())
             stop_event.set()
             service.poke()
+            log(f"bench: deadline fired at {deadline_seconds:.0f}s; draining")
+            # Grace period for graceful stops (completed iterations are
+            # still reported), then hard-abort the stragglers: a full
+            # graceful drain pays one round-trip per remaining depth-1
+            # step of EVERY young fiber — minutes of tunnel time that
+            # measure nothing.
+            await asyncio.sleep(15)
+            service.hard_stop_all()
         watchdog = asyncio.create_task(fire())
 
     it = iter(jobs)
@@ -401,7 +423,7 @@ async def run_searches(service, jobs, nodes: int,
                 pending.add(asyncio.ensure_future(one(*job)))
     if watchdog is not None:
         watchdog.cancel()
-    return total
+    return total, at_deadline
 
 
 def main() -> None:
@@ -418,7 +440,12 @@ def main() -> None:
     device = bench_device_evaluator(params)
     log(f"bench: device tier done in {time.perf_counter() - t:.1f}s: {device}")
 
-    n_searches = CONCURRENT_BATCHES * POSITIONS_PER_BATCH
+    n_searches = int(
+        _os.environ.get(
+            "FISHNET_BENCH_CONCURRENCY",
+            CONCURRENT_BATCHES * POSITIONS_PER_BATCH,
+        )
+    )
 
     log("bench: creating search service (jax backend)...")
     weights = NnueWeights.random(seed=7)
@@ -433,7 +460,10 @@ def main() -> None:
         log("bench: building workload (distinct game lines)...")
         # 3x the in-flight window so the rolling refill never runs dry
         # inside the measurement window.
-        jobs = make_workload(3 * CONCURRENT_BATCHES, POSITIONS_PER_BATCH)
+        jobs = make_workload(
+            3 * max(CONCURRENT_BATCHES, n_searches // POSITIONS_PER_BATCH),
+            POSITIONS_PER_BATCH,
+        )
         log("bench: XLA warmup (compiles each eval-size bucket)...")
         t = time.perf_counter()
         service.warmup()
@@ -446,24 +476,40 @@ def main() -> None:
         )
         before = service.counters()
         start = time.perf_counter()
-        total_nodes = asyncio.run(
+        total_nodes, at_deadline = asyncio.run(
             run_searches(service, jobs, NODES_PER_SEARCH,
                          deadline_seconds=BENCH_SECONDS,
                          concurrency=n_searches)
         )
         elapsed = time.perf_counter() - start
-        after = service.counters()
+        if not at_deadline:
+            # Watchdog never fired (workload drained early, or a zero
+            # deadline): fall back to end-of-run counters over the real
+            # elapsed time instead of crashing after a multi-minute run.
+            at_deadline = service.counters()
     finally:
         service.close()
 
-    window = {
-        k: after[k] - before[k] for k in after if k != "prefetch_budget"
-    }
-    window["prefetch_budget"] = after["prefetch_budget"]
-    traffic = traffic_report(window, total_nodes)
+    window_seconds = BENCH_SECONDS if BENCH_SECONDS > 0 else elapsed
+    window_seconds = min(window_seconds, elapsed) or 1e-9
 
-    nps = total_nodes / elapsed
-    log(f"bench: {total_nodes} nodes in {elapsed:.2f}s; traffic {traffic}")
+    # Steady-state rate over the measurement window only, from the
+    # pool's live node counter snapshotted when the deadline fired —
+    # the post-deadline drain (shrinking fiber population) measures
+    # teardown, not throughput.
+    window = {
+        k: at_deadline[k] - before[k]
+        for k in at_deadline
+        if k != "prefetch_budget"
+    }
+    window["prefetch_budget"] = at_deadline.get("prefetch_budget", 0)
+    traffic = traffic_report(window, window["nodes"])
+
+    nps = window["nodes"] / window_seconds
+    log(
+        f"bench: window {window['nodes']} nodes in {window_seconds:.0f}s "
+        f"({total_nodes} incl. drain, total {elapsed:.1f}s); traffic {traffic}"
+    )
 
     log("bench: search quality (scalar backend, transport-free)...")
     t = time.perf_counter()

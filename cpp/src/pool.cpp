@@ -84,6 +84,8 @@ struct Slot {
   // thread, e.g. service close) while the search polls it per node:
   // atomic, relaxed ordering suffices (it's a latch, not a handoff).
   std::atomic<bool> stop_requested{false};
+  // Hard abort (no first-iteration guarantee); see SearchLimits.
+  std::atomic<bool> abort_requested{false};
   // Eval request state (valid while wants_eval): a block of 1..EVAL_BLOCK_MAX.
   // Features are stored as uint16 (delta indices reach 2*22528+1, still
   // uint16): half the memory per slot and the emission into the device
@@ -391,7 +393,9 @@ int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
   slot.limits.depth = depth;
   slot.limits.multipv = multipv;
   slot.stop_requested = false;
+  slot.abort_requested = false;
   slot.limits.stop = &slot.stop_requested;
+  slot.limits.abort_now = &slot.abort_requested;
   slot.use_scalar = use_scalar != 0 && pool->scalar_eval != nullptr;
   slot.active = true;
   slot.started = false;
@@ -438,6 +442,15 @@ void fc_pool_stop_all(SearchPool* pool) {
   for (auto& slot : pool->slots) slot->stop_requested = true;
 }
 
+// Hard-abort every active search: unwind at the next node without the
+// first-iteration guarantee (results may be empty). For teardown paths
+// where wall clock matters more than partial results — on a ~150 ms
+// round-trip link a graceful drain of thousands of young fibers costs
+// minutes; this costs one step. Safe from any thread.
+void fc_pool_abort_all(SearchPool* pool) {
+  for (auto& slot : pool->slots) slot->abort_requested = true;
+}
+
 // Run all runnable fibers until each is blocked on an eval or finished.
 // Writes up to `capacity` pending eval requests (features [i][2][32],
 // bucket [i], slot id [i]) and returns the count. Returns 0 when no
@@ -447,12 +460,22 @@ namespace {
 // Append slot i's whole eval block to the group's outgoing batch if it
 // fits. Features go out as uint16 (22528 fits): half the bytes across
 // the host->device link, which is a scarce resource.
-bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
-                std::unordered_map<uint64_t, int>& seen,
-                std::vector<std::tuple<int, int, int>>& aliases,
-                int i, uint16_t* out_features, int32_t* out_buckets,
-                int32_t* out_slots, int32_t* out_parent,
-                int32_t* out_material, int capacity, int align) {
+// Result of trying to place one slot's eval block into the batch.
+enum EmitResult {
+  EMIT_OK = 0,        // emitted (or served as a dedup alias)
+  EMIT_FULL = 1,      // batch out of capacity: genuine pressure signal
+  EMIT_MISALIGNED = 2 // block would straddle a shard boundary; NOT
+                      // pressure — the AIMD budget must not react, or
+                      // routine straddles would pin speculation at 0
+};
+
+EmitResult emit_block(SearchPool* pool,
+                      std::vector<std::pair<int, int>>& batch,
+                      std::unordered_map<uint64_t, int>& seen,
+                      std::vector<std::tuple<int, int, int>>& aliases,
+                      int i, uint16_t* out_features, int32_t* out_buckets,
+                      int32_t* out_slots, int32_t* out_parent,
+                      int32_t* out_material, int capacity, int align) {
   Slot& slot = *pool->slots[i];
   int base = int(batch.size());
   // In-step dedup: a single-entry demand request whose position is
@@ -467,10 +490,10 @@ bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
       pool->dedup_evals.fetch_add(1, std::memory_order_relaxed);
       slot.alias_pending = true;
       aliases.emplace_back(i, 0, it->second);
-      return true;
+      return EMIT_OK;
     }
   }
-  if (base + slot.block_n > capacity) return false;  // wait for next step
+  if (base + slot.block_n > capacity) return EMIT_FULL;  // next step
   // Shard alignment (sharded serving): a block must not straddle an
   // `align`-entry boundary, so every delta entry and its anchor land in
   // the same mesh shard and the sharded eval needs NO cross-device
@@ -479,7 +502,7 @@ bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
   // still fill the gap this block skipped.
   if (align > 0 && slot.block_n > 1 &&
       base / align != (base + slot.block_n - 1) / align)
-    return false;
+    return EMIT_MISALIGNED;
   // One fiber block served by this device round-trip.
   pool->suspensions.fetch_add(1, std::memory_order_relaxed);
   for (int j = 0; j < slot.block_n; j++) {
@@ -502,7 +525,7 @@ bool emit_block(SearchPool* pool, std::vector<std::pair<int, int>>& batch,
     seen.emplace(slot.entry_hash[j], idx);  // dedup target for later singles
     batch.emplace_back(i, j);
   }
-  return true;
+  return EMIT_OK;
 }
 
 }  // namespace
@@ -538,9 +561,9 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
     if (!slot.active || slot.finished || !slot.wants_eval ||
         slot.alias_pending)
       continue;
-    if (!emit_block(pool, batch, seen, aliases, int(i), out_features,
-                    out_buckets, out_slots, out_parent, out_material,
-                    capacity, align))
+    if (emit_block(pool, batch, seen, aliases, int(i), out_features,
+                   out_buckets, out_slots, out_parent, out_material,
+                   capacity, align) == EMIT_FULL)
       overflow = true;
   }
 
@@ -585,9 +608,9 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
     } else if (slot.wants_eval) {
       // Blocks that don't fit stay suspended; phase 1 of the next step
       // picks them up first.
-      if (!emit_block(pool, batch, seen, aliases, int(i), out_features,
-                      out_buckets, out_slots, out_parent, out_material,
-                      capacity, align))
+      if (emit_block(pool, batch, seen, aliases, int(i), out_features,
+                     out_buckets, out_slots, out_parent, out_material,
+                     capacity, align) == EMIT_FULL)
         overflow = true;
     }
   }
@@ -671,9 +694,12 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
 // [8] current prefetch budget (adaptive; instantaneous, not cumulative)
 // [9] eval slots shipped as incremental deltas (DMA-savings coverage)
 // [10] requests answered by in-step dedup (no slot shipped)
+// [11] search nodes visited, LIVE (bumped per node, not at finish) —
+//      lets telemetry compute steady-state nps over a time window
+//      without waiting for searches to complete
 int fc_pool_counters(SearchPool* pool, uint64_t* out, int n) {
   constexpr auto R = std::memory_order_relaxed;
-  const uint64_t vals[11] = {
+  const uint64_t vals[12] = {
       pool->steps.load(R),          pool->evals_shipped.load(R),
       pool->suspensions.load(R),    pool->step_capacity.load(R),
       pool->counters.demand_evals.load(R),
@@ -683,8 +709,9 @@ int fc_pool_counters(SearchPool* pool, uint64_t* out, int n) {
       uint64_t(pool->prefetch_budget.load(R)),
       pool->delta_evals.load(R),
       pool->dedup_evals.load(R),
+      pool->counters.nodes.load(R),
   };
-  int k = n < 11 ? n : 11;
+  int k = n < 12 ? n : 12;
   for (int i = 0; i < k; i++) out[i] = vals[i];
   return k;
 }

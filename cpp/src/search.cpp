@@ -368,8 +368,11 @@ int Search::prefetch_evals(const Position& pos, const MoveList& children,
 
 int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
   nodes_++;
-  if (allow_stop_ &&
-      ((node_limit_ && nodes_ >= node_limit_) || (external_stop_ && *external_stop_)))
+  if (counters_) counters_->bump(counters_->nodes);
+  if ((allow_stop_ &&
+       ((node_limit_ && nodes_ >= node_limit_) ||
+        (external_stop_ && *external_stop_))) ||
+      (abort_now_ && *abort_now_))
     stopped_ = true;
   if (stopped_ || ply >= MAX_PLY) return evaluate(pos);
 
@@ -520,8 +523,11 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
   if (depth <= 0) return qsearch(pos, alpha, beta, ply);
 
   nodes_++;
-  if (allow_stop_ &&
-      ((node_limit_ && nodes_ >= node_limit_) || (external_stop_ && *external_stop_)))
+  if (counters_) counters_->bump(counters_->nodes);
+  if ((allow_stop_ &&
+       ((node_limit_ && nodes_ >= node_limit_) ||
+        (external_stop_ && *external_stop_))) ||
+      (abort_now_ && *abort_now_))
     stopped_ = true;
   if (stopped_) return 0;
 
@@ -725,6 +731,7 @@ SearchResult Search::run(const Position& root,
   stopped_ = false;
   allow_stop_ = false;
   external_stop_ = limits.stop;
+  abort_now_ = limits.abort_now;
   path_ = game_history;
   if (path_.empty() || path_.back() != root.hash) path_.push_back(root.hash);
   root_history_len_ = path_.size();
@@ -804,6 +811,7 @@ SearchResult Search::run(const Position& root,
     // At least one full iteration is in the bag; the node budget may now
     // interrupt freely.
     allow_stop_ = true;
+    if (abort_now_ && *abort_now_) break;
     if (node_limit_ && nodes_ >= node_limit_) break;
     if (external_stop_ && *external_stop_) break;
   }

@@ -140,6 +140,7 @@ struct SearchCounters {
   std::atomic<uint64_t> prefetch_shipped{0}; // speculative evals shipped
   std::atomic<uint64_t> prefetch_hits{0};    // speculative evals consumed
   std::atomic<uint64_t> tt_eval_hits{0};     // evals answered from the TT
+  std::atomic<uint64_t> nodes{0};            // search nodes visited (live)
   void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
     c.fetch_add(n, std::memory_order_relaxed);
   }
@@ -153,6 +154,11 @@ struct SearchLimits {
   // polled per node, may be set from any thread. The first depth-1
   // iteration still completes.
   const std::atomic<bool>* stop = nullptr;
+  // Hard abort: polled per node WITHOUT the first-iteration guarantee —
+  // the search unwinds immediately and may return an empty result.
+  // For teardown paths (bench drain, service shutdown) where partial
+  // results are worthless but wall-clock is not.
+  const std::atomic<bool>* abort_now = nullptr;
 };
 
 struct PvLine {
@@ -217,6 +223,7 @@ class Search {
   // at least one scored line, whatever the node budget.
   bool allow_stop_ = false;
   const std::atomic<bool>* external_stop_ = nullptr;
+  const std::atomic<bool>* abort_now_ = nullptr;
   std::vector<uint64_t> path_;  // hashes from game start through search path
   size_t root_history_len_ = 0;
   Move killers_[MAX_PLY][2];

@@ -331,7 +331,7 @@ def main(argv=None) -> int:
             return 2
         from fishnet_tpu.verify_net import run_cli
 
-        return run_cli(str(opt.nnue_file), verbose=opt.verbose)
+        return run_cli(str(opt.nnue_file))
     if opt.command == "uci":
         from fishnet_tpu.uci_server import serve
 

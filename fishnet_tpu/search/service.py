@@ -74,6 +74,7 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib.fc_pool_submit.restype = ctypes.c_int
     lib.fc_pool_stop.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.fc_pool_stop_all.argtypes = [ctypes.c_void_p]
+    lib.fc_pool_abort_all.argtypes = [ctypes.c_void_p]
     lib.fc_pool_step.argtypes = [
         ctypes.c_void_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
@@ -402,6 +403,14 @@ class SearchService:
         """Wake the driver (after setting a search's stop_event)."""
         self._wake.set()
 
+    def hard_stop_all(self) -> None:
+        """Hard-abort every in-flight search (no first-iteration
+        guarantee; results may be empty). Teardown aid: a graceful drain
+        of thousands of young fibers costs one round-trip per remaining
+        depth-1 step — minutes on a high-latency link."""
+        self._lib.fc_pool_abort_all(self._pool)
+        self._wake.set()
+
     def set_prefetch(self, budget: int, adaptive: bool = True) -> None:
         """Pin (adaptive=False) or re-seed the pool's speculation budget.
         Pinning makes TT evolution deterministic across backends — the
@@ -416,13 +425,13 @@ class SearchService:
         the measurements behind occupancy / prefetch-ROI / cache-rate
         (see cpp SearchCounters). Safe to read at any time; values are
         monotone and single-writer."""
-        buf = (ctypes.c_uint64 * 11)()
-        n = self._lib.fc_pool_counters(self._pool, buf, 11)
+        buf = (ctypes.c_uint64 * 12)()
+        n = self._lib.fc_pool_counters(self._pool, buf, 12)
         out = {k: int(buf[i]) for i, k in enumerate((
             "steps", "evals_shipped", "suspensions", "step_capacity",
             "demand_evals", "prefetch_shipped", "prefetch_hits",
             "tt_eval_hits", "prefetch_budget", "delta_evals",
-            "dedup_evals",
+            "dedup_evals", "nodes",
         )[:n])}
         # Service-side: slots actually transferred (size-bucketed).
         out["eval_steps"] = self._eval_steps

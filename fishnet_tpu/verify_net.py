@@ -184,7 +184,7 @@ def verify_net(
     return ok
 
 
-def run_cli(path: str, verbose: bool = False) -> int:
+def run_cli(path: str) -> int:
     ok = verify_net(path, log=print)
     print("verify-net: " + ("PASS" if ok else "FAIL"))
     return 0 if ok else 1
