@@ -354,7 +354,10 @@ void fc_pool_free(SearchPool* pool) { delete pool; }
 // line, for history/repetitions). variant: a VariantRules value;
 // non-standard variants are evaluated with the classical HCE on the host
 // (the reference's MultiVariant flavor) and never suspend for the device.
-// Returns the slot id, or -1 if the pool is full / input invalid.
+// Returns the slot id, or a negative error: -1 pool full (retry after a
+// release), -2/-3 invalid fen/variant/moves, -4 fiber stack exhaustion,
+// -5 standard-variant search on a pool built without a scalar net (a
+// configuration error — resubmitting cannot clear it).
 int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
                    uint64_t nodes, int depth, int multipv, int use_scalar,
                    int variant) {
@@ -368,6 +371,13 @@ int fc_pool_submit(SearchPool* pool, const char* fen, const char* moves,
   Slot& slot = *pool->slots[id];
 
   if (variant < VR_STANDARD || variant > VR_THREE_CHECK) return -2;
+  // A standard-variant search needs the scalar net: the batched bridge
+  // walks net->ft_psqt host-side (fill_full/fill_delta material term)
+  // and the scalar backend IS the net — and a use_scalar request with
+  // no net would silently fall back to that same bridge. Refuse the
+  // submit instead of crashing later; a netless pool (fc_pool_new
+  // allows one) still serves variant/HCE searches.
+  if (variant == VR_STANDARD && !pool->scalar_net) return -5;
   Position pos;
   if (!pos.set_fen(fen ? fen : "", VariantRules(variant)).empty()) return -2;
   slot.history.clear();

@@ -374,7 +374,12 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
         (external_stop_ && *external_stop_))) ||
       (abort_now_ && *abort_now_))
     stopped_ = true;
-  if (stopped_ || ply >= MAX_PLY) return evaluate(pos);
+  // Once stopped (node budget, external stop, or hard abort) the value
+  // is discarded by every unwinding caller: return a constant like
+  // alpha_beta does instead of shipping one more device eval per
+  // stopping fiber (one wasted round-trip each).
+  if (stopped_) return 0;
+  if (ply >= MAX_PLY) return evaluate(pos);
 
   if (pos.variant != VR_STANDARD) {
     int vres;

@@ -125,6 +125,36 @@ async def test_illegal_submit_rejected(service):
         )
 
 
+def test_netless_pool_refuses_standard_search():
+    # A pool built without a scalar net (legal: variant/HCE-only use)
+    # must refuse standard-variant submits instead of crashing in the
+    # batched bridge's host-side PSQT walk (cpp fill_full needs the net).
+    from fishnet_tpu.chess.board import _VARIANT_CODES
+    from fishnet_tpu.chess.core import load
+    from fishnet_tpu.protocol.types import Variant
+    from fishnet_tpu.search.service import _bind_pool_api
+
+    lib = load()
+    _bind_pool_api(lib)
+    pool = lib.fc_pool_new(4, 1 << 20, b"", 1)
+    assert pool
+    try:
+        start = b"rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+        for use_scalar in (0, 1):
+            rc = lib.fc_pool_submit(
+                pool, start, b"", 1000, 2, 1, use_scalar,
+                _VARIANT_CODES[Variant.STANDARD],
+            )
+            assert rc == -5
+        # Variant searches evaluate with the HCE and stay serviceable.
+        rc = lib.fc_pool_submit(
+            pool, start, b"", 1000, 1, 1, 0, _VARIANT_CODES[Variant.ANTICHESS]
+        )
+        assert rc >= 0
+    finally:
+        lib.fc_pool_free(pool)
+
+
 async def test_tiny_batch_capacity_clamped():
     """A capacity below the native core's largest eval block
     (EVAL_BLOCK_MAX=40, cpp/src/search.h:32) would livelock: emit_block is
