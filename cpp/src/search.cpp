@@ -25,79 +25,95 @@ size_t floor_pow2(size_t n) {
 
 TranspositionTable::TranspositionTable(size_t bytes) {
   size_t clusters = floor_pow2(
-      std::max<size_t>(256, bytes / (sizeof(TTEntry) * CLUSTER)));
-  entries_.resize(clusters * CLUSTER);
+      std::max<size_t>(256, bytes / ((sizeof(Packed) + 2) * CLUSTER)));
+  entries_ = std::vector<Packed>(clusters * CLUSTER);
+  gens_.assign(clusters * CLUSTER, 0);
   mask_ = clusters - 1;
 }
 
-TTEntry* TranspositionTable::probe(uint64_t key, bool& hit) {
-  TTEntry* c = cluster(key);
+bool TranspositionTable::probe(uint64_t key, TTData& out) {
+  constexpr auto R = std::memory_order_relaxed;
+  Packed* c = cluster(key);
   for (int i = 0; i < CLUSTER; i++) {
+    uint64_t d = c[i].data.load(R);
+    if (!(d >> 63) || (c[i].kx.load(R) ^ d) != key) continue;
+    TTData t = unpack(d);
     // An entry counts as a hit if it carries either a search bound or a
     // cached static eval for this key.
-    if (c[i].key == key &&
-        (c[i].bound != TT_NONE || c[i].eval != TT_EVAL_NONE)) {
-      hit = true;
-      return &c[i];
+    if (t.bound != TT_NONE || t.eval != TT_EVAL_NONE) {
+      out = t;
+      return true;
     }
   }
-  hit = false;
-  return c;
+  return false;
 }
 
 void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
                                int depth, TTBound bound) {
-  TTEntry* c = cluster(key);
-  TTEntry* e = nullptr;
-  for (int i = 0; i < CLUSTER; i++)
-    if (c[i].key == key) {
-      e = &c[i];
+  constexpr auto R = std::memory_order_relaxed;
+  Packed* c = cluster(key);
+  uint16_t* g = &gens_[(key & mask_) * CLUSTER];
+  uint16_t gen = gen_.load(R);
+  int idx = -1;
+  TTData cur;
+  for (int i = 0; i < CLUSTER; i++) {
+    uint64_t d = c[i].data.load(R);
+    if ((d >> 63) && (c[i].kx.load(R) ^ d) == key) {
+      idx = i;
+      cur = unpack(d);
       break;
     }
-  if (e != nullptr) {
+  }
+  if (idx >= 0) {
     // Same position: depth-preferred within a generation, merging the
     // old best move / cached eval when the new store lacks them.
-    if (e->bound != TT_NONE && e->gen == gen_ && depth < e->depth &&
+    if (cur.bound != TT_NONE && g[idx] == gen && depth < cur.depth &&
         bound != TT_EXACT)
       return;
-    if (move == MOVE_NONE) move = e->move;
-    if (eval == TT_EVAL_NONE) eval = e->eval;
+    if (move == MOVE_NONE) move = cur.move;
+    if (eval == TT_EVAL_NONE) eval = cur.eval;
   } else {
     // Victim: the weakest of the cluster — stale generations first,
     // then shallowest depth (eval-only entries have depth 0 and go
-    // before any bound-carrying entry of equal staleness).
+    // before any bound-carrying entry of equal staleness). A torn
+    // concurrent entry decodes to garbage ranking here, which only
+    // means a different victim gets picked — benign.
     int worst = 1 << 30;
     for (int i = 0; i < CLUSTER; i++) {
-      int score = int(c[i].depth) + (c[i].gen == gen_ ? 512 : 0) +
-                  (c[i].bound != TT_NONE ? 256 : 0);
+      TTData t = unpack(c[i].data.load(R));
+      int score = int(t.depth) + (g[i] == gen ? 512 : 0) +
+                  (t.bound != TT_NONE ? 256 : 0);
       if (score < worst) {
         worst = score;
-        e = &c[i];
+        idx = i;
+        cur = t;
       }
     }
     // When even the weakest slot holds a fresh, bound-carrying, deeper
     // entry, drop the store: under pressure, deep results are worth
     // more than this shallower one (measured — evicting them cost a
     // third of a ply at a 2 MiB table).
-    if (e->bound != TT_NONE && e->gen == gen_ && e->depth > depth &&
+    if (cur.bound != TT_NONE && g[idx] == gen && cur.depth > depth &&
         bound != TT_EXACT)
       return;
   }
-  e->key = key;
-  e->move = move;
-  e->value = int16_t(value);
-  e->eval = int16_t(eval);
-  e->depth = uint8_t(std::max(0, depth));
-  e->bound = bound;
-  e->gen = gen_;
-  // A repurposed victim slot must not inherit a stale speculative tag:
-  // the next TT eval hit on this key would count a false prefetch hit
-  // and inflate the ROI telemetry the budget policy is tuned against.
-  e->prefetched = 0;
+  // A repurposed victim slot must not inherit a stale speculative tag
+  // (pack sets prefetched=false): the next TT eval hit on this key
+  // would count a false prefetch hit and inflate the ROI telemetry the
+  // budget policy is tuned against.
+  uint64_t d = pack(move, int16_t(value), int16_t(eval),
+                    uint8_t(std::min(std::max(0, depth), 127)), bound,
+                    /*prefetched=*/false);
+  c[idx].data.store(d, R);
+  c[idx].kx.store(key ^ d, R);
+  g[idx] = gen;
 }
 
 void TranspositionTable::store_eval(uint64_t key, int eval, bool speculative) {
-  TTEntry* c = cluster(key);
+  constexpr auto R = std::memory_order_relaxed;
+  Packed* c = cluster(key);
+  uint16_t* g = &gens_[(key & mask_) * CLUSTER];
+  uint16_t gen = gen_.load(R);
   // Victim ranking among bound-free slots (bound-carrying entries are
   // never evicted by a cheap static eval): empty beats unconsumed
   // speculative beats stale-generation eval-only. Round 2 claimed only
@@ -105,35 +121,49 @@ void TranspositionTable::store_eval(uint64_t key, int eval, bool speculative) {
   // once the table warmed up (measured ROI 0.0008): each dropped child
   // eval then cost a fresh demand round-trip, the exact latency the
   // prefetch was bought to hide.
-  TTEntry* victim = nullptr;
+  int victim = -1;
   int victim_rank = 0;
   for (int i = 0; i < CLUSTER; i++) {
-    if (c[i].key == key) {
-      if (c[i].eval == TT_EVAL_NONE) {
-        c[i].eval = int16_t(eval);
-        c[i].prefetched = speculative ? 1 : 0;
+    uint64_t d = c[i].data.load(R);
+    bool occupied = d >> 63;
+    TTData t = unpack(d);
+    if (occupied && (c[i].kx.load(R) ^ d) == key) {
+      if (t.eval == TT_EVAL_NONE) {
+        uint64_t nd = pack(t.move, t.value, int16_t(eval), t.depth, t.bound,
+                           speculative);
+        c[i].data.store(nd, R);
+        c[i].kx.store(key ^ nd, R);
       }
       return;
     }
-    if (c[i].bound != TT_NONE) continue;
-    int rank = c[i].eval == TT_EVAL_NONE ? 3   // empty
-               : c[i].prefetched         ? 2   // unconsumed speculation
-               : c[i].gen != gen_        ? 1   // stale cached eval
-                                         : 0;  // fresh demand eval: keep
+    if (occupied && t.bound != TT_NONE) continue;
+    int rank = !occupied || t.eval == TT_EVAL_NONE ? 3  // empty
+               : t.prefetched                      ? 2  // unconsumed speculation
+               : g[i] != gen                       ? 1  // stale cached eval
+                                                   : 0;  // fresh demand eval: keep
     if (rank > victim_rank) {
       victim_rank = rank;
-      victim = &c[i];
+      victim = i;
     }
   }
-  if (victim != nullptr) {
-    victim->key = key;
-    victim->move = MOVE_NONE;
-    victim->value = 0;
-    victim->eval = int16_t(eval);
-    victim->depth = 0;
-    victim->bound = TT_NONE;
-    victim->gen = gen_;
-    victim->prefetched = speculative ? 1 : 0;
+  if (victim >= 0) {
+    uint64_t d = pack(MOVE_NONE, 0, int16_t(eval), 0, TT_NONE, speculative);
+    c[victim].data.store(d, R);
+    c[victim].kx.store(key ^ d, R);
+    g[victim] = gen;
+  }
+}
+
+void TranspositionTable::consume_prefetch(uint64_t key) {
+  constexpr auto R = std::memory_order_relaxed;
+  Packed* c = cluster(key);
+  for (int i = 0; i < CLUSTER; i++) {
+    uint64_t d = c[i].data.load(R);
+    if (!(d >> 63) || (c[i].kx.load(R) ^ d) != key) continue;
+    uint64_t nd = d & ~(1ull << 62);
+    c[i].data.store(nd, R);
+    c[i].kx.store(key ^ nd, R);
+    return;
   }
 }
 
@@ -340,9 +370,9 @@ int Search::prefetch_evals(const Position& pos, const MoveList& children,
     Position child = pos;
     child.make(m);
     if (child.in_check()) continue;  // won't stand pat; eval unused
-    bool hit;
-    TTEntry* te = tt_->probe(child.hash, hit);
-    if (hit && te->eval != EVAL_NONE) continue;  // already cached
+    TTData te;
+    if (tt_->probe(child.hash, te) && te.eval != EVAL_NONE)
+      continue;  // already cached
     prefetch_block_[k] = child;
     prefetch_keys_[k] = child.hash;
     k++;
@@ -440,16 +470,17 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
     // Stand pat, with the TT's cached static eval when available. On a
     // miss, evaluate this node AND its best capture children in one
     // round-trip — the recursion below then stands pat from the TT.
-    bool hit;
-    TTEntry* tte = tt_->probe(pos.hash, hit);
+    TTData tte;
+    bool hit = tt_->probe(pos.hash, tte);
     int stand;
-    if (hit && tte->eval != EVAL_NONE) {
-      stand = tte->eval;
+    if (hit && tte.eval != EVAL_NONE) {
+      stand = tte.eval;
       if (counters_ && eval_->batched()) {
         counters_->bump(counters_->tt_eval_hits);
-        if (tte->prefetched) {
+        if (tte.prefetched) {
           counters_->bump(counters_->prefetch_hits);
-          tte->prefetched = 0;  // count each speculative eval once
+          // Count each speculative eval once.
+          tt_->consume_prefetch(pos.hash);
         }
       }
       if (stand >= beta) return stand;  // before any targets/order work
@@ -543,14 +574,14 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
   beta = std::min(beta, VALUE_MATE - (ply + 1));
   if (alpha >= beta) return alpha;
 
-  bool hit;
-  TTEntry* tte = tt_->probe(pos.hash, hit);
-  Move tt_move = hit ? tte->move : MOVE_NONE;
-  if (hit && !is_pv && ply > 0 && tte->depth >= depth && tte->bound != TT_NONE) {
-    int v = value_from_tt(tte->value, ply);
-    if ((tte->bound == TT_EXACT) ||
-        (tte->bound == TT_LOWER && v >= beta) ||
-        (tte->bound == TT_UPPER && v <= alpha))
+  TTData tte;
+  bool hit = tt_->probe(pos.hash, tte);
+  Move tt_move = hit ? tte.move : MOVE_NONE;
+  if (hit && !is_pv && ply > 0 && tte.depth >= depth && tte.bound != TT_NONE) {
+    int v = value_from_tt(tte.value, ply);
+    if ((tte.bound == TT_EXACT) ||
+        (tte.bound == TT_LOWER && v >= beta) ||
+        (tte.bound == TT_UPPER && v <= alpha))
       return v;
   }
 

@@ -74,26 +74,39 @@ class HceEval : public EvalBridge {
   int evaluate(const Position& pos) override { return hce_evaluate(pos); }
 };
 
-// -- transposition table (shared across all searches; the scheduler is
-// single-threaded so no synchronization is needed) ------------------------
+// -- transposition table (shared across all searches AND all scheduler
+// threads) ----------------------------------------------------------------
+//
+// Lockless: each entry is two relaxed-atomic 64-bit words, `data`
+// (the packed payload) and `kx` (= key ^ data). A reader validates by
+// re-deriving the key; a torn read — data from one store, kx from a
+// concurrent other — fails the XOR check and reads as a miss, exactly
+// like a key collision. This is the standard multi-threaded engine TT
+// (the reference's engines use the same trick for their SMP builds);
+// it costs no synchronization on the probe fast path, which multiple
+// scheduler threads hit millions of times per second (the reference
+// sidesteps the problem with one engine *process* per core,
+// /root/reference/src/main.rs:158-170 — a shared table is strictly
+// stronger: adjacent plies of one game share work across threads).
 
 enum TTBound : uint8_t { TT_NONE = 0, TT_UPPER = 1, TT_LOWER = 2, TT_EXACT = 3 };
 
 // Sentinel for "no cached static eval" in a TT entry.
 constexpr int16_t TT_EVAL_NONE = 32001;
 
-struct TTEntry {
-  uint64_t key = 0;
+// Decoded (snapshot) view of a TT entry: probe() fills one; callers
+// never see table memory directly.
+struct TTData {
   Move move = MOVE_NONE;
   int16_t value = 0;
   int16_t eval = TT_EVAL_NONE;
   uint8_t depth = 0;
-  uint8_t bound = TT_NONE;
-  uint16_t gen = 0;
+  TTBound bound = TT_NONE;
   // The cached eval came from a speculative prefetch and has not been
-  // consumed yet (cleared on first use) — feeds the prefetch hit-rate
-  // counter so the block policy can be tuned against measurements.
-  uint8_t prefetched = 0;
+  // consumed yet (cleared via consume_prefetch) — feeds the prefetch
+  // hit-rate counter so the block policy can be tuned against
+  // measurements.
+  bool prefetched = false;
 };
 
 class TranspositionTable {
@@ -105,9 +118,9 @@ class TranspositionTable {
   static constexpr int CLUSTER = 4;
 
   explicit TranspositionTable(size_t bytes = 256ull << 20);
-  // On hit, the matching entry. On miss, some entry of the cluster —
-  // callers must not read it (every call site guards on `hit`).
-  TTEntry* probe(uint64_t key, bool& hit);
+  // Lockless lookup: true and a decoded snapshot if the table holds a
+  // bound or cached eval for this key.
+  bool probe(uint64_t key, TTData& out);
   void store(uint64_t key, Move move, int value, int eval, int depth, TTBound bound);
   // Cache a speculative static eval without ever evicting an entry that
   // carries a search bound or eval for a different key — prefetched
@@ -115,15 +128,56 @@ class TranspositionTable {
   // with 4-way clusters there are four chances to find a free slot.
   // `speculative` tags the entry for prefetch hit-rate accounting.
   void store_eval(uint64_t key, int eval, bool speculative = false);
-  void new_generation() { gen_++; }
+  // Clear the speculative tag on this key's entry (each prefetched eval
+  // is counted as a hit at most once).
+  void consume_prefetch(uint64_t key);
+  void new_generation() { gen_.fetch_add(1, std::memory_order_relaxed); }
 
  private:
-  TTEntry* cluster(uint64_t key) {
-    return &entries_[(key & mask_) * CLUSTER];
+  struct Packed {
+    std::atomic<uint64_t> kx{0};    // key ^ data (0,0 = empty: see OCCUPIED)
+    std::atomic<uint64_t> data{0};
+  };
+  // Payload layout (64 bits):
+  //   [0,16)  value  (int16 as uint16)
+  //   [16,32) eval   (int16 as uint16; TT_EVAL_NONE = none)
+  //   [32,53) move   (the 21 used bits of Move; all-ones = MOVE_NONE —
+  //                   from==to makes that pattern unreachable by legal
+  //                   moves)
+  //   [53,60) depth  (0..127; MAX_PLY-1 fits)
+  //   [60,62) bound
+  //   [62]    prefetched
+  //   [63]    OCCUPIED — a zero-initialized entry must not validate for
+  //           a position whose hash happens to be 0
+  static uint64_t pack(Move move, int16_t value, int16_t eval, uint8_t depth,
+                       TTBound bound, bool prefetched) {
+    return (uint64_t(uint16_t(value))) | (uint64_t(uint16_t(eval)) << 16) |
+           (uint64_t(move & 0x1FFFFF) << 32) | (uint64_t(depth & 0x7F) << 53) |
+           (uint64_t(bound) << 60) | (uint64_t(prefetched) << 62) |
+           (1ull << 63);
   }
-  std::vector<TTEntry> entries_;
+  static TTData unpack(uint64_t d) {
+    TTData out;
+    out.value = int16_t(uint16_t(d));
+    out.eval = int16_t(uint16_t(d >> 16));
+    uint32_t m = uint32_t((d >> 32) & 0x1FFFFF);
+    out.move = m == 0x1FFFFF ? MOVE_NONE : Move(m);
+    out.depth = uint8_t((d >> 53) & 0x7F);
+    out.bound = TTBound((d >> 60) & 0x3);
+    out.prefetched = (d >> 62) & 1;
+    return out;
+  }
+  Packed* cluster(uint64_t key) { return &entries_[(key & mask_) * CLUSTER]; }
+  std::vector<Packed> entries_;
+  // Per-entry generation, OUTSIDE the XOR-validated pair: it only feeds
+  // replacement ranking, where a racy read merely picks a slightly
+  // different victim — not worth a packed bit. Indexed like entries_.
+  // 16 bits: new_generation() bumps once per Search::run, and a pool
+  // serving hundreds of searches/s would wrap 8 bits in seconds,
+  // aliasing ancient entries as fresh in the replacement ranking.
+  std::vector<uint16_t> gens_;
   size_t mask_;  // cluster-index mask
-  uint16_t gen_ = 0;
+  std::atomic<uint16_t> gen_{0};
 };
 
 // -- search ---------------------------------------------------------------

@@ -17,6 +17,7 @@ if [ -n "$NNUE_FILE" ]; then args+=("--nnue-file" "$NNUE_FILE"); fi
 if [ -n "$AZ_NET_FILE" ]; then args+=("--az-net-file" "$AZ_NET_FILE"); fi
 if [ -n "$MICROBATCH" ]; then args+=("--microbatch" "$MICROBATCH"); fi
 if [ -n "$PIPELINE" ]; then args+=("--pipeline" "$PIPELINE"); fi
+if [ -n "$SEARCH_THREADS" ]; then args+=("--search-threads" "$SEARCH_THREADS"); fi
 if [ -n "$MESH" ]; then args+=("--mesh" "$MESH"); fi
 
 exec python -m fishnet_tpu "${args[@]}"

@@ -164,6 +164,7 @@ def build_search_service(opt: Opt, logger: Logger):
         batch_capacity=opt.resolved_microbatch(),
         pipeline_depth=depth,
         evaluator=evaluator,
+        driver_threads=opt.resolved_search_threads(),
     )
 
 

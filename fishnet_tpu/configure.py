@@ -186,6 +186,11 @@ class Opt:
     az_net_file: Optional[str] = None
     microbatch: Optional[int] = None
     pipeline: Optional[int] = None
+    #: Scheduler threads driving the shared search pool (the host
+    #: parallelism tier: each thread steps its own slot groups' fibers;
+    #: the reference gets the same from one engine process per core,
+    #: src/main.rs:158-170). Default: the resolved worker-core count.
+    search_threads: Optional[int] = None
     #: Device-mesh policy for the serving evaluator: "auto" (shard the
     #: eval batch whenever >1 device is visible), "off" (single device),
     #: or an explicit "DATAxMODEL" shape such as "4x2".
@@ -208,6 +213,11 @@ class Opt:
 
     def resolved_microbatch(self) -> int:
         return self.microbatch if self.microbatch is not None else 1024
+
+    def resolved_search_threads(self) -> int:
+        if self.search_threads is not None:
+            return self.search_threads
+        return self.resolved_cores()
 
     def resolved_mesh(self) -> str:
         return self.mesh or "auto"
@@ -256,6 +266,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Eval pipeline depth (in-flight device batches). Default: "
                         "probe the device at startup (serialized tunnels get 1, "
                         "locally attached TPUs 2-4).")
+    p.add_argument("--search-threads", type=int, default=None,
+                   help="Scheduler threads driving the search pool (host "
+                        "parallelism tier). Default: the worker-core count.")
     p.add_argument("--mesh", default=None,
                    help="Device mesh for the serving evaluator: auto (default; "
                         "shard eval batches over all visible devices), off "
@@ -297,6 +310,10 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
         if ns.pipeline < 1:
             raise ConfigError("--pipeline must be >= 1")
         opt.pipeline = ns.pipeline
+    if ns.search_threads is not None:
+        if ns.search_threads < 1:
+            raise ConfigError("--search-threads must be >= 1")
+        opt.search_threads = ns.search_threads
     if ns.mesh is not None:
         opt.mesh = parse_mesh(ns.mesh)
     return opt
@@ -319,6 +336,7 @@ _INI_FIELDS = (
     ("NnueFile", "nnue_file", str),
     ("AzNetFile", "az_net_file", str),
     ("Mesh", "mesh", parse_mesh),
+    ("SearchThreads", "search_threads", int),
 )
 
 

@@ -2,14 +2,21 @@
 fiber pool + JAX evaluator.
 
 Topology (SURVEY.md §7): every worker's ``go(position)`` submits a search
-into one shared native pool. A single driver thread runs the pool's
-step/evaluate/provide cycle: `fc_pool_step` advances all search fibers to
-their next leaf evaluations, the pending leaves are evaluated as ONE
-JAX/TPU microbatch, `fc_pool_provide` wakes the fibers. Search results
-resolve asyncio futures back on the event loop.
+into one shared native pool. Driver threads run the pool's
+step/evaluate/provide cycle: `fc_pool_step` advances a slot group's
+search fibers to their next leaf evaluations, the pending leaves are
+evaluated as ONE JAX/TPU microbatch, `fc_pool_provide` wakes the fibers.
+Search results resolve asyncio futures back on the event loop.
 
-ctypes calls release the GIL, so fiber execution (C++) and the TPU
-dispatch overlap with the event loop's HTTP work.
+HOST PARALLELISM (VERDICT r3 #1): the pool's slots are partitioned into
+``driver_threads * pipeline_depth`` groups; each driver thread owns
+``pipeline_depth`` of them and steps their fibers concurrently with
+every other thread — the answer to the reference's one-engine-process-
+per-core model (src/main.rs:158-170). The threads share the lockless
+transposition table (adjacent plies of one game share work across
+threads) and the device; ctypes calls release the GIL, so the C++ fiber
+execution genuinely runs in parallel and overlaps the TPU dispatch and
+the event loop's HTTP work.
 """
 
 from __future__ import annotations
@@ -56,6 +63,7 @@ class _Pending:
     started: float
     token: object = None
     stop_event: Optional[threading.Event] = None
+    thread: int = 0  # owning driver thread index
 
 
 def _bind_pool_api(lib: ctypes.CDLL) -> None:
@@ -67,7 +75,7 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib.fc_pool_new.restype = ctypes.c_void_p
     lib.fc_pool_free.argtypes = [ctypes.c_void_p]
     lib.fc_pool_submit.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int,
     ]
@@ -86,9 +94,9 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int,
     ]
-    lib.fc_pool_active.argtypes = [ctypes.c_void_p]
+    lib.fc_pool_active.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.fc_pool_active.restype = ctypes.c_int
-    lib.fc_pool_next_finished.argtypes = [ctypes.c_void_p]
+    lib.fc_pool_next_finished.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.fc_pool_next_finished.restype = ctypes.c_int
     lib.fc_pool_result_summary.argtypes = [
         ctypes.c_void_p, ctypes.c_int,
@@ -194,6 +202,7 @@ class SearchService:
         eval_sizes: Optional[Sequence[int]] = None,
         pipeline_depth: int = 1,
         evaluator=None,
+        driver_threads: int = 1,
     ) -> None:
         """``evaluator``: optional callable ``(params, indices, buckets) ->
         int32 [B]`` replacing the built-in single-device
@@ -240,11 +249,26 @@ class SearchService:
         self.pipeline_depth = (
             1 if backend == "scalar" else max(1, min(pipeline_depth, pool_slots))
         )
+        # Host-parallel scheduling: each driver thread owns
+        # `pipeline_depth` slot groups and steps them independently of
+        # every other thread (slots i with (i mod n_groups) in the
+        # thread's group range). batch_capacity is PER THREAD — total
+        # in-flight device work scales with the thread count, which is
+        # the point: one thread's fiber stepping caps out one core.
+        # Clamp so n_groups never exceeds pool_slots: the native pool
+        # would silently clamp its group count while Python threads kept
+        # driving the out-of-range groups (fc_pool_step folds those to
+        # group 0 — concurrent unsynchronized stepping) and submits to
+        # them would hang forever.
+        self.driver_threads = max(
+            1, min(int(driver_threads), pool_slots // self.pipeline_depth)
+        )
+        self._n_groups = self.driver_threads * self.pipeline_depth
 
         # The scalar net is always loaded into the pool: it serves the
         # "scalar" backend and is the fallback if JAX is unusable.
         self._pool = self._lib.fc_pool_new(
-            pool_slots, tt_bytes, self.net_path.encode(), self.pipeline_depth
+            pool_slots, tt_bytes, self.net_path.encode(), self._n_groups
         )
         if not self._pool:
             raise NativeCoreError("failed to create search pool")
@@ -303,9 +327,10 @@ class SearchService:
             self._eval_sizes = sorted({min(s, cap) for s in sizes})
             self._shard_align = 0
         # uint16 feature indices: half the host->device transfer bytes.
-        # One buffer set per pipeline group: group i's buffers must stay
-        # untouched while its dispatched eval is still in flight.
-        k = self.pipeline_depth
+        # One buffer set per group: a group's buffers must stay
+        # untouched while its dispatched eval is still in flight, and
+        # each group is only ever touched by its owning thread.
+        k = self._n_groups
         self._feat_buf = np.empty((k, cap, 2, spec.MAX_ACTIVE_FEATURES), dtype=np.uint16)
         self._bucket_buf = np.empty((k, cap), dtype=np.int32)
         self._slot_buf = np.empty((k, cap), dtype=np.int32)
@@ -316,23 +341,34 @@ class SearchService:
         # cpp fill_full/fill_delta): 4 bytes/position on the wire buys
         # the device out of the whole PSQT gather.
         self._material_buf = np.empty((k, cap), dtype=np.int32)
-        # Shipped-bucket accounting (driver thread writes, telemetry
-        # reads; int += is GIL-atomic): occupancy against the bucket
-        # actually transferred, not the configured capacity — a lightly
-        # loaded step that ships the 1k bucket is not "5% occupied".
-        self._eval_steps = 0
-        self._bucket_slots = 0
-        self._pending: Dict[int, _Pending] = {}
-        self._submissions: List[Tuple] = []
-        self._stop_requests: List[Tuple[int, _Pending]] = []
-        self._cancelled_tokens: set = set()
+        # Per-thread state: each driver thread owns one cell of each
+        # list, so the hot paths touch no shared structure (the shared
+        # _lock guards only the event-loop handoff queues).
+        T = self.driver_threads
+        # Shipped-bucket accounting (owning thread writes its own cell,
+        # telemetry sums): occupancy against the bucket actually
+        # transferred, not the configured capacity — a lightly loaded
+        # step that ships the 1k bucket is not "5% occupied".
+        self._eval_steps = [0] * T
+        self._bucket_slots = [0] * T
+        self._pending: List[Dict[int, _Pending]] = [{} for _ in range(T)]
+        self._submissions: List[List[Tuple]] = [[] for _ in range(T)]
+        self._cancelled_tokens: List[set] = [set() for _ in range(T)]
         self._lock = threading.Lock()
         self._warmup_lock = threading.Lock()
         self._warmed = False
-        self._wake = threading.Event()
+        self._wakes = [threading.Event() for _ in range(T)]
+        self._rr = 0  # round-robin submission cursor over threads
         self._stopping = False
-        self._thread = threading.Thread(target=self._drive, name="search-driver", daemon=True)
-        self._thread.start()
+        self._threads = [
+            threading.Thread(
+                target=self._drive, args=(t,), name=f"search-driver-{t}",
+                daemon=True,
+            )
+            for t in range(T)
+        ]
+        for th in self._threads:
+            th.start()
 
     # -- public API -------------------------------------------------------
 
@@ -357,20 +393,32 @@ class SearchService:
         with self._lock:
             if self._stopping:
                 raise NativeCoreError("search service is shut down")
-            self._submissions.append(
+            # Round-robin over driver threads: searches are statistically
+            # uniform, so static assignment balances like the reference's
+            # per-core worker split (src/main.rs:158-170).
+            t = self._rr % self.driver_threads
+            self._rr += 1
+            self._submissions[t].append(
                 (root_fen, " ".join(moves), nodes, depth, multipv, future, loop,
                  movetime_seconds, variant, token, stop_event)
             )
-        self._wake.set()
+        self._wakes[t].set()
         try:
             return await future
         except asyncio.CancelledError:
             # Caller gave up (worker time budget / UCI stop): stop the
             # underlying native search so it frees its pool slot instead
-            # of orphan-draining the shared evaluator.
+            # of orphan-draining the shared evaluator. The token also
+            # covers the still-queued case (skipped at drain); a search
+            # already in a slot is stopped directly — its driver thread
+            # may be blocked inside the very native step running it.
             with self._lock:
-                self._cancelled_tokens.add(token)
-            self._wake.set()
+                self._cancelled_tokens[t].add(token)
+                for slot, p in self._pending[t].items():
+                    if p.token is token:
+                        self._lib.fc_pool_stop(self._pool, slot)
+                        break
+            self._wakes[t].set()
             raise
 
     def warmup(self) -> None:
@@ -400,8 +448,19 @@ class SearchService:
             self._warmed = True
 
     def poke(self) -> None:
-        """Wake the driver (after setting a search's stop_event)."""
-        self._wake.set()
+        """Wake the drivers (after setting a search's stop_event). Also
+        applies set stop_events directly: the native per-slot stop flags
+        are atomic latches safe from any thread, and the owning driver
+        may be BLOCKED inside fc_pool_step running the very search that
+        must stop (a scalar/HCE search never suspends) — routing the
+        stop through its loop would deadlock."""
+        with self._lock:
+            for t in range(self.driver_threads):
+                for slot, p in self._pending[t].items():
+                    if p.stop_event is not None and p.stop_event.is_set():
+                        self._lib.fc_pool_stop(self._pool, slot)
+        for w in self._wakes:
+            w.set()
 
     def hard_stop_all(self) -> None:
         """Hard-abort every in-flight search (no first-iteration
@@ -409,7 +468,8 @@ class SearchService:
         of thousands of young fibers costs one round-trip per remaining
         depth-1 step — minutes on a high-latency link."""
         self._lib.fc_pool_abort_all(self._pool)
-        self._wake.set()
+        for w in self._wakes:
+            w.set()
 
     def set_prefetch(self, budget: int, adaptive: bool = True) -> None:
         """Pin (adaptive=False) or re-seed the pool's speculation budget.
@@ -434,42 +494,52 @@ class SearchService:
             "dedup_evals", "nodes",
         )[:n])}
         # Service-side: slots actually transferred (size-bucketed).
-        out["eval_steps"] = self._eval_steps
-        out["bucket_slots"] = self._bucket_slots
+        out["eval_steps"] = sum(self._eval_steps)
+        out["bucket_slots"] = sum(self._bucket_slots)
         return out
 
     def is_alive(self) -> bool:
-        """False once the service is shut down or its driver crashed —
+        """False once the service is shut down or any driver crashed —
         callers holding a handle should build a fresh service (the
         engine-restart analogue of the reference's subprocess respawn,
         src/main.rs:284-312)."""
         with self._lock:
             if self._stopping:
                 return False
-        return self._thread.is_alive()
+        return all(th.is_alive() for th in self._threads)
 
     def _maybe_stop(self, slot: int, pending: _Pending) -> None:
-        """Movetime watchdog (event-loop thread): hand the stop request to
-        the driver thread, which owns the pool and the slot mapping —
-        avoids a cross-thread write and the slot-reuse TOCTOU."""
+        """Movetime watchdog (event-loop thread): stop the native search
+        directly — the per-slot stop flag is an atomic latch safe from
+        any thread, and the owning driver may be BLOCKED inside
+        fc_pool_step running this very search (scalar/HCE searches never
+        suspend), so routing through its loop could never fire. The
+        slot-reuse TOCTOU is closed by the identity check under _lock:
+        pending-map inserts (submit) and removals (harvest) hold the
+        same lock, so the slot cannot have been released and resubmitted
+        while we look."""
         with self._lock:
-            self._stop_requests.append((slot, pending))
-        self._wake.set()
+            if self._pending[pending.thread].get(slot) is pending:
+                self._lib.fc_pool_stop(self._pool, slot)
+        self._wakes[pending.thread].set()
 
     def close(self) -> None:
         with self._lock:
             self._stopping = True
-        # Unblock a driver stuck inside a long native step: every search
+        # Unblock drivers stuck inside a long native step: every search
         # polls its stop flag per node, so this unwinds promptly even
         # mid-scalar-search (safe from any thread: the per-slot stop flags
         # are std::atomic<bool> latches).
         if self._pool:
             self._lib.fc_pool_stop_all(self._pool)
-        self._wake.set()
-        self._thread.join(timeout=60)
-        if self._thread.is_alive():
+        for w in self._wakes:
+            w.set()
+        deadline = time.monotonic() + 60
+        for th in self._threads:
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
+        if any(th.is_alive() for th in self._threads):
             # Driver stuck (e.g. inside a long XLA compile): leak the pool
-            # rather than freeing memory the thread still dereferences.
+            # rather than freeing memory a thread still dereferences.
             return
         if self._pool:
             self._lib.fc_pool_free(self._pool)
@@ -501,8 +571,9 @@ class SearchService:
             if n <= s:
                 size = s
                 break
-        self._eval_steps += 1
-        self._bucket_slots += size
+        t = group // self.pipeline_depth  # owning thread's telemetry cell
+        self._eval_steps[t] += 1
+        self._bucket_slots[t] += size
         feats = self._feat_buf[group]
         buckets = self._bucket_buf[group]
         parents = self._parent_buf[group]
@@ -523,88 +594,106 @@ class SearchService:
 
     # -- driver thread ----------------------------------------------------
 
-    def _drive(self) -> None:
+    def _drive(self, t: int) -> None:
         try:
-            self._drive_inner()
+            self._drive_inner(t)
         except Exception as err:  # noqa: BLE001 - driver must not die silently
-            self._fail_all(NativeCoreError(f"search driver crashed: {err!r}"))
+            # Flag first so sibling threads stop too, then fail this
+            # thread's own futures (each sibling fails its own on exit).
+            # stop_all unsticks siblings BLOCKED inside a long native
+            # step (scalar/HCE searches never suspend): the per-node
+            # stop poll is the only signal such a thread can see.
             self._stopping = True
+            if self._pool:
+                self._lib.fc_pool_stop_all(self._pool)
+            for w in self._wakes:
+                w.set()
+            self._fail_all(t, NativeCoreError(f"search driver crashed: {err!r}"))
             raise
 
-    def _drive_inner(self) -> None:
+    def _drive_inner(self, t: int) -> None:
         lib = self._lib
-        cap = self.batch_capacity
-        k = self.pipeline_depth
-        feat_ptrs = [
-            self._feat_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
-            for g in range(k)
-        ]
-        bucket_ptrs = [
-            self._bucket_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-            for g in range(k)
-        ]
-        slot_ptrs = [
-            self._slot_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-            for g in range(k)
-        ]
-        parent_ptrs = [
-            self._parent_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-            for g in range(k)
-        ]
-        material_ptrs = [
-            self._material_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-            for g in range(k)
-        ]
+        # This thread's slot groups (disjoint from every other thread's).
+        groups = range(t * self.pipeline_depth, (t + 1) * self.pipeline_depth)
+        pending = self._pending[t]
+        feat_ptrs = {
+            g: self._feat_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+            for g in groups
+        }
+        bucket_ptrs = {
+            g: self._bucket_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            for g in groups
+        }
+        slot_ptrs = {
+            g: self._slot_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            for g in groups
+        }
+        parent_ptrs = {
+            g: self._parent_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            for g in groups
+        }
+        material_ptrs = {
+            g: self._material_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            for g in groups
+        }
         # In-flight device evals per group: group -> (n, dispatched array).
         # The software pipeline: resolve group g's previous eval (blocks
         # only on the oldest dispatch), wake its fibers, step them to new
         # leaves, dispatch the next eval — then move to group g+1 while
-        # this one rides the host<->device link. With k groups up to k
-        # batches overlap CPU search, transfer, and device compute.
+        # this one rides the host<->device link. With k groups per thread
+        # up to k batches overlap CPU search, transfer, and device
+        # compute — and T threads' CPU phases overlap each other.
         inflight: Dict[int, Tuple[int, object]] = {}
 
-        # Compile every eval-size bucket up front, on this thread: a
-        # first-touch XLA compile mid-traffic would stall every in-flight
-        # search at each bucket boundary. Submissions queue meanwhile.
+        # Compile every eval-size bucket up front (first thread compiles,
+        # the rest block on the shared warmup lock): a first-touch XLA
+        # compile mid-traffic would stall every in-flight search at each
+        # bucket boundary. Submissions queue meanwhile.
         self.warmup()
 
         while True:
             if self._stopping:
-                self._fail_all(NativeCoreError("service shut down"))
+                self._fail_all(t, NativeCoreError("service shut down"))
                 return
 
-            # Apply movetime-watchdog stops (driver thread owns the pool).
+            # Catch-up stop pass. Direct stops (movetime watchdog,
+            # cancellation, poke) already hit in-slot searches from the
+            # event-loop thread; this covers stop_events set without a
+            # poke() and tokens cancelled while their search was still
+            # queued.
             with self._lock:
-                stop_requests, self._stop_requests = self._stop_requests, []
-                cancelled, self._cancelled_tokens = self._cancelled_tokens, set()
-            for slot, pending in stop_requests:
-                if self._pending.get(slot) is pending:
-                    lib.fc_pool_stop(self._pool, slot)
-            for slot, pending in self._pending.items():
-                if pending.token in cancelled or (
-                    pending.stop_event is not None and pending.stop_event.is_set()
-                ):
-                    lib.fc_pool_stop(self._pool, slot)
+                cancelled = self._cancelled_tokens[t]
+                self._cancelled_tokens[t] = set()
+                for slot, p in pending.items():
+                    if p.token in cancelled or (
+                        p.stop_event is not None and p.stop_event.is_set()
+                    ):
+                        lib.fc_pool_stop(self._pool, slot)
 
-            # Drain submissions into pool slots.
+            # Drain this thread's submissions into its groups' slots.
             with self._lock:
-                submissions, self._submissions = self._submissions, []
+                submissions = self._submissions[t]
+                self._submissions[t] = []
             for item in submissions:
                 (fen, moves, nodes, depth, multipv, future, loop, movetime,
                  variant, token, stop_event) = item
                 if token in cancelled:
                     continue
                 use_scalar = 1 if self.backend == "scalar" else 0
-                slot = lib.fc_pool_submit(
-                    self._pool, fen.encode(), moves.encode(),
-                    nodes, depth, multipv, use_scalar,
-                    _VARIANT_CODES[variant],
-                )
+                slot = -1
+                for g in groups:
+                    slot = lib.fc_pool_submit(
+                        self._pool, g, fen.encode(), moves.encode(),
+                        nodes, depth, multipv, use_scalar,
+                        _VARIANT_CODES[variant],
+                    )
+                    if slot != -1:
+                        break
                 if slot == -1:
-                    # Pool momentarily full: requeue; a slot frees up once
-                    # a running search is harvested below.
+                    # Groups momentarily full: requeue; a slot frees up
+                    # once a running search is harvested below.
                     with self._lock:
-                        self._submissions.append(item)
+                        self._submissions[t].append(item)
                     continue
                 if slot < 0:
                     loop.call_soon_threadsafe(
@@ -612,11 +701,14 @@ class SearchService:
                         NativeCoreError(f"submit failed ({slot})"),
                     )
                     continue
-                pending = _Pending(future, loop, time.monotonic(), token, stop_event)
-                self._pending[slot] = pending
+                p = _Pending(future, loop, time.monotonic(), token, stop_event, t)
+                # Under _lock: the event-loop side (watchdog, cancel,
+                # poke) identity-checks this map before stopping a slot.
+                with self._lock:
+                    pending[slot] = p
                 if movetime is not None:
                     loop.call_soon_threadsafe(
-                        loop.call_later, movetime, self._maybe_stop, slot, pending
+                        loop.call_later, movetime, self._maybe_stop, slot, p
                     )
 
             # close() may have raced the submission drain above (a fresh
@@ -627,7 +719,7 @@ class SearchService:
                     continue
 
             stepped = 0
-            for g in range(k):
+            for g in groups:
                 if g in inflight:
                     n_prev, arr = inflight.pop(g)
                     values = self._resolve_eval(n_prev, arr)
@@ -648,21 +740,24 @@ class SearchService:
                         raise NativeCoreError("no evaluator")  # pragma: no cover
                     inflight[g] = (n, self._dispatch_eval(g, n))
 
-            # Harvest finished searches.
-            while True:
-                slot = lib.fc_pool_next_finished(self._pool)
-                if slot < 0:
-                    break
-                self._finish_slot(slot)
+            # Harvest this thread's finished searches.
+            for g in groups:
+                while True:
+                    slot = lib.fc_pool_next_finished(self._pool, g)
+                    if slot < 0:
+                        break
+                    self._finish_slot(t, slot)
 
-            if stepped == 0 and not inflight and lib.fc_pool_active(self._pool) == 0:
+            if stepped == 0 and not inflight and all(
+                lib.fc_pool_active(self._pool, g) == 0 for g in groups
+            ):
                 with self._lock:
-                    idle = not self._submissions and not self._stopping
+                    idle = not self._submissions[t] and not self._stopping
                 if idle:
-                    self._wake.wait(timeout=0.05)
-                    self._wake.clear()
+                    self._wakes[t].wait(timeout=0.05)
+                    self._wakes[t].clear()
 
-    def _finish_slot(self, slot: int) -> None:
+    def _finish_slot(self, t: int, slot: int) -> None:
         lib = self._lib
         nodes = ctypes.c_uint64()
         depth = ctypes.c_int32()
@@ -672,7 +767,8 @@ class SearchService:
             self._pool, slot, ctypes.byref(nodes), ctypes.byref(depth),
             bm, len(bm), ctypes.byref(nlines),
         )
-        pending = self._pending.pop(slot, None)
+        with self._lock:
+            pending = self._pending[t].pop(slot, None)
         if pending is None:
             lib.fc_pool_release(self._pool, slot)
             return
@@ -717,15 +813,20 @@ class SearchService:
         )
         pending.loop.call_soon_threadsafe(_set_res, pending.future, result)
 
-    def _fail_all(self, err: Exception) -> None:
-        """Resolve every outstanding future: in-flight searches AND
-        submissions still queued (or requeued after a pool-full submit)
-        that never reached a slot — otherwise their callers hang."""
-        for pending in self._pending.values():
-            pending.loop.call_soon_threadsafe(_set_exc, pending.future, err)
-        self._pending.clear()
+    def _fail_all(self, t: int, err: Exception) -> None:
+        """Resolve every outstanding future owned by thread ``t``:
+        in-flight searches AND submissions still queued (or requeued
+        after a pool-full submit) that never reached a slot — otherwise
+        their callers hang. Each driver thread fails its own state on
+        exit; a crash in one thread flags _stopping so the others do the
+        same at their loop top."""
         with self._lock:
-            submissions, self._submissions = self._submissions, []
+            doomed = list(self._pending[t].values())
+            self._pending[t].clear()
+            submissions = self._submissions[t]
+            self._submissions[t] = []
+        for pending in doomed:
+            pending.loop.call_soon_threadsafe(_set_exc, pending.future, err)
         for item in submissions:
             future, loop = item[5], item[6]
             loop.call_soon_threadsafe(_set_exc, future, err)

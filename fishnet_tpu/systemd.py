@@ -78,6 +78,8 @@ def _exec_start(opt: Opt, *, absolute: bool) -> str:
         args += ["--az-net-file", shlex.quote(path(opt.az_net_file))]
     if opt.pipeline is not None:
         args += ["--pipeline", str(opt.pipeline)]
+    if opt.search_threads is not None:
+        args += ["--search-threads", str(opt.search_threads)]
     if opt.mesh is not None:
         args += ["--mesh", opt.mesh]
 

@@ -142,13 +142,14 @@ def test_netless_pool_refuses_standard_search():
         start = b"rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
         for use_scalar in (0, 1):
             rc = lib.fc_pool_submit(
-                pool, start, b"", 1000, 2, 1, use_scalar,
+                pool, -1, start, b"", 1000, 2, 1, use_scalar,
                 _VARIANT_CODES[Variant.STANDARD],
             )
             assert rc == -5
         # Variant searches evaluate with the HCE and stay serviceable.
         rc = lib.fc_pool_submit(
-            pool, start, b"", 1000, 1, 1, 0, _VARIANT_CODES[Variant.ANTICHESS]
+            pool, -1, start, b"", 1000, 1, 1, 0,
+            _VARIANT_CODES[Variant.ANTICHESS],
         )
         assert rc >= 0
     finally:
@@ -372,8 +373,10 @@ async def test_scalar_vs_jax_depth1_score_parity():
     incremental delta entries through the sparse gather path) must agree
     on the score and best move exactly, position by position (VERDICT
     round 1: search-level parity at scale, not a handful of spot
-    checks)."""
-    fens = _random_fens(150, seed=99)
+    checks). Default-gate smoke: 40 positions; the bulk sweeps behind
+    the `slow` marker are the at-scale venue (VERDICT r3 weak #4: the
+    commit gate must stay fast on a 1-core box)."""
+    fens = _random_fens(40, seed=99)
     weights = NnueWeights.random(seed=21)
     scalar = await _depth1_results("scalar", weights, fens)
     jax_out = await _depth1_results("jax", weights, fens)
@@ -401,8 +404,22 @@ async def test_scalar_vs_jax_depth4_score_parity():
     batched backend's TT evolution is deterministic; the TT is sized so
     cluster-eviction differences (the one legitimate divergence channel:
     speculative entries exist only in the batched run and can tip a
-    victim choice under pressure) stay out of reach."""
-    fens = _random_fens(150, seed=77)
+    victim choice under pressure) stay out of reach.
+
+    Default-gate smoke: 30 positions (VERDICT r3 weak #4); the full
+    150-position sweep is test_scalar_vs_jax_depth4_parity_full behind
+    the `slow` marker."""
+    await _depth4_parity_sweep(_random_fens(30, seed=77))
+
+
+@pytest.mark.slow
+async def test_scalar_vs_jax_depth4_parity_full():
+    """The full 150-position depth-4 sweep (the pre-r4 default gate),
+    now in the `slow` venue CI runs as its own job."""
+    await _depth4_parity_sweep(_random_fens(150, seed=77))
+
+
+async def _depth4_parity_sweep(fens):
     weights = NnueWeights.random(seed=21)
     kw = dict(depth=4, tt_bytes=256 << 20, prefetch=8)
     scalar = await _parity_results("scalar", weights, fens, **kw)
