@@ -265,6 +265,10 @@ int BatchedEval::evaluate(const Position& pos) {
 
 struct SearchPool {
   TranspositionTable tt;
+  // Shared continuation-history tables (search.h SharedHistory): like
+  // the TT, one instance serves every search and scheduler thread;
+  // racy heuristic updates are benign by design.
+  SharedHistory shared_history;
   // Pool-level eval-traffic accounting. Written by the scheduler thread
   // only; read cross-thread by fc_pool_counters, hence relaxed atomics.
   SearchCounters counters;
@@ -635,8 +639,8 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_features,
       bool see_full = slot.root.variant != VR_STANDARD
                           ? true
                           : pp->net_material_correlated;
-      slot.search =
-          std::make_unique<Search>(&pp->tt, eval, &pp->counters, see_full);
+      slot.search = std::make_unique<Search>(
+          &pp->tt, eval, &pp->counters, see_full, &pp->shared_history);
       slot.fiber->start([sp] {
         sp->result = sp->search->run(sp->root, sp->history, sp->limits);
       });

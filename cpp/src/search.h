@@ -12,6 +12,8 @@
 
 #include <atomic>
 #include <cstdint>
+#include <cstdlib>
+#include <cstring>
 #include <string>
 #include <vector>
 
@@ -180,6 +182,40 @@ class TranspositionTable {
   std::atomic<uint16_t> gen_{0};
 };
 
+// -- shared move-ordering history ----------------------------------------
+//
+// Continuation history, SHARED across all searches of a pool (like the
+// TT): per-Search storage would cost ~1.2 MB x thousands of fiber
+// slots, and sharing is a feature — fibers analyzing adjacent plies of
+// one game teach each other refutation patterns. Indexed by the
+// previous move's (piece, to-square) and the candidate's (piece, to-
+// square); piece codes include color (make_piece, 0..11). Updates are
+// racy across scheduler threads by design: a lost heuristic increment
+// merely reorders a move, it cannot corrupt a result (same class of
+// benign race every SMP engine accepts for its history tables).
+struct ContinuationHistory {
+  static constexpr int PIECES = 12;
+  int16_t table[PIECES][64][PIECES][64];
+  ContinuationHistory() { std::memset(table, 0, sizeof(table)); }
+  int16_t* slot(int prev_pc, Square prev_to, int pc, Square to) {
+    return &table[prev_pc][prev_to][pc][to];
+  }
+  // Standard history gravity: saturates toward +-LIMIT, recent signals
+  // outweigh stale ones, no periodic aging pass needed.
+  static void bump(int16_t* h, int bonus) {
+    constexpr int LIMIT = 1 << 14;
+    int v = *h + bonus - int(*h) * std::abs(bonus) / LIMIT;
+    *h = int16_t(v);
+  }
+};
+
+// The pool's shared ordering state: 1-ply and 2-ply continuation
+// history (the two highest-value tables per Stockfish's own ablations).
+struct SharedHistory {
+  ContinuationHistory cont1;
+  ContinuationHistory cont2;
+};
+
 // -- search ---------------------------------------------------------------
 
 // Shared eval-traffic accounting. Single writer (the scheduler thread
@@ -238,9 +274,13 @@ class Search {
   // NNUE-backed searches and hard-codes true for HCE ones; the
   // depth-scaled SEE prune in the main search is active regardless (it
   // was measured to shrink the tree even under a material-blind net).
+  // ``shared``: the pool's shared continuation-history tables; nullptr
+  // (standalone searches) degrades to plain per-search history.
   Search(TranspositionTable* tt, EvalBridge* eval,
-         SearchCounters* counters = nullptr, bool see_full = true)
-      : tt_(tt), eval_(eval), counters_(counters), see_full_(see_full) {}
+         SearchCounters* counters = nullptr, bool see_full = true,
+         SharedHistory* shared = nullptr)
+      : tt_(tt), eval_(eval), counters_(counters), see_full_(see_full),
+        shared_(shared) {}
 
   // Run a full iterative-deepening search. game_history: Zobrist hashes
   // of positions before root (for repetition detection), most recent last.
@@ -265,11 +305,22 @@ class Search {
                      bool include_self, int max_children);
   bool is_repetition_or_50(const Position& pos, int ply) const;
   void order_moves(const Position& pos, MoveList& moves, Move tt_move, int ply);
+  // Score moves into ``scores`` — the single banding source for every
+  // ordering consumer. ``eager_see``: demote losing captures now
+  // (full-traversal consumers) instead of deferring SEE to pick time.
+  void score_moves(const Position& pos, const MoveList& moves, Move tt_move,
+                   int ply, int* scores, bool eager_see = false);
+  // Quiet-history reads/updates spanning plain history + 1/2-ply
+  // continuation history (shared tables when the pool provides them).
+  int quiet_history(const Position& pos, Move m, int ply) const;
+  void update_quiet_stats(const Position& pos, Move best, int depth, int ply,
+                          const Move* tried, int n_tried);
 
   TranspositionTable* tt_;
   EvalBridge* eval_;
   SearchCounters* counters_ = nullptr;
   bool see_full_ = true;
+  SharedHistory* shared_ = nullptr;
   uint64_t nodes_ = 0;
   uint64_t node_limit_ = 0;
   bool stopped_ = false;
@@ -283,13 +334,20 @@ class Search {
   Move killers_[MAX_PLY][2];
   int history_[COLOR_NB][64][64];
   // Countermove heuristic: the quiet refutation of the opponent's last
-  // move (indexed by its from/to squares). Deliberately no continuation
-  // history: at [6][64][6][64] x int16 it would cost ~300 KB per Search,
-  // and thousands of concurrent pool slots each own a Search.
+  // move (indexed by its from/to squares). Continuation history lives
+  // in the pool's SharedHistory (shared_), not here: ~1.2 MB per table
+  // would not fit thousands of per-slot Search objects.
   Move countermove_[64][64];
-  // move_stack_[p] = the move that led to the node at ply p (MOVE_NONE
-  // at the root and after a null move); feeds countermove bookkeeping.
+  // move_stack_[p] / piece_stack_[p] = the move that led to the node at
+  // ply p and the (color-coded) piece that made it (MOVE_NONE at the
+  // root and after a null move); feeds countermove + continuation
+  // history bookkeeping.
   Move move_stack_[MAX_PLY + 1];
+  int piece_stack_[MAX_PLY + 1];
+  // Per-ply excluded move for singular-extension verification searches
+  // (MOVE_NONE when none): the move loop skips it, and neither TT
+  // cutoffs nor TT stores apply at a node searched with an exclusion.
+  Move excluded_[MAX_PLY + 1];
   Move pv_table_[MAX_PLY][MAX_PLY];
   int pv_len_[MAX_PLY];
   std::vector<Move> excluded_root_moves_;  // for MultiPV iteration
