@@ -75,6 +75,13 @@ NODES_PER_SEARCH = int(_os.environ.get('FISHNET_BENCH_NODES', 4_000))
 #: plus compiles, keeping the whole bench inside a 10-minute budget even
 #: in bad tunnel weather.
 BENCH_SECONDS = float(_os.environ.get("FISHNET_BENCH_SECONDS", 180.0))
+#: Device batch capacity (per step). 2x the in-flight fiber demand by
+#: default: the AIMD speculation budget can only grow into HEADROOM —
+#: at a capacity equal to steady-state demand, every speculative slot
+#: displaces a demand eval and the budget correctly pins near zero
+#: (measured r4: capacity 16384 at ~15k demand slots -> budget 1,
+#: delta_coverage 0.48; the verdict target needs room to spend).
+BENCH_CAPACITY = int(_os.environ.get("FISHNET_BENCH_CAPACITY", 32768))
 
 
 def log(msg: str) -> None:
@@ -264,6 +271,11 @@ def bench_realized_mix(params, captured: dict) -> dict:
         "batch": size,
         "delta_share": round(float((parent >= 0).mean()), 4),
     }
+    if "packed_rows" in captured:
+        # Wire cost of this batch under the compact format vs dense.
+        out["wire_kb_packed"] = round(captured["packed_rows"] * 32 / 1024)
+        out["wire_kb_dense"] = round(size * 128 / 1024)
+        out["real_entries"] = captured.get("real_n")
     if per_eval_s <= 0:
         out["evals_per_s"] = None
         out["device_ms_per_batch"] = None
@@ -271,6 +283,65 @@ def bench_realized_mix(params, captured: dict) -> dict:
         out["evals_per_s"] = round(size / per_eval_s)
         out["device_ms_per_batch"] = round(per_eval_s * 1e3, 3)
     return out
+
+
+def bench_az() -> dict:
+    """AZ/MCTS tier (BASELINE.json config 5; VERDICT r3 weak #5 — the
+    batched-PUCT path had correctness tests but no performance
+    artifact): visits/s and eval-batch occupancy through MctsPool's
+    synchronous collect->evaluate->expand core with many concurrent
+    searches, plus one fixed-position quality probe (the recorded move/
+    value lets rounds be compared even with random weights)."""
+    import jax
+    import numpy as np
+
+    from fishnet_tpu.search.mcts import MctsConfig, MctsPool
+    from fishnet_tpu.models.az import init_az_params
+
+    cfg = MctsConfig()
+    params = jax.device_put(init_az_params(jax.random.PRNGKey(7), cfg.az))
+    pool = MctsPool(params, cfg)
+    pool.warmup()
+
+    visits = int(_os.environ.get("FISHNET_BENCH_AZ_VISITS", 300))
+    n_searches = int(_os.environ.get("FISHNET_BENCH_AZ_SEARCHES", 64))
+    sids = [
+        pool.submit(FENS[i % len(FENS)], [], visits=visits)
+        for i in range(n_searches)
+    ]
+    t0 = time.perf_counter()
+    steps = 0
+    evaluated = 0
+    while pool.active() > 0:
+        n = pool.step()
+        steps += 1
+        evaluated += n
+        if n == 0 and pool.active() == 0:
+            break
+    dt = max(time.perf_counter() - t0, 1e-9)
+    total_visits = 0
+    for sid in sids:
+        total_visits += pool.harvest(sid).visits
+
+    # Quality probe: one deeper search of a fixed tactical position.
+    probe_sid = pool.submit(FENS[3], [], visits=4 * visits)
+    while pool.active() > 0:
+        pool.step()
+    probe = pool.harvest(probe_sid)
+    return {
+        "visits_per_s": round(total_visits / dt),
+        "evals_per_s": round(evaluated / dt),
+        "steps": steps,
+        "batch_occupancy": round(evaluated / max(1, steps * cfg.batch_capacity), 4),
+        "visits": total_visits,
+        "concurrent_searches": n_searches,
+        "probe": {
+            "fen": FENS[3],
+            "visits": probe.visits,
+            "best_move": probe.lines[0].move if probe.lines else None,
+            "cp": probe.lines[0].cp if probe.lines else None,
+        },
+    }
 
 
 def bench_host_scaling() -> dict:
@@ -392,6 +463,11 @@ def traffic_report(counters: dict, total_nodes: int) -> dict:
         ),
         "tt_eval_hits": counters["tt_eval_hits"],
         "prefetch_budget": counters["prefetch_budget"],
+        # Host->device payload per step under the compact wire format
+        # (packed delta rows ship 32 bytes/entry instead of 128).
+        "wire_mb_per_step": round(
+            counters.get("wire_bytes", 0) / steps / 1e6, 3
+        ),
         # Fraction of shipped eval slots that went out as incremental
         # deltas (8 row-DMAs instead of ~64 on the device).
         "delta_coverage": round(
@@ -616,9 +692,11 @@ def main() -> None:
     service = SearchService(
         weights=weights,
         pool_slots=n_searches + 256,
-        batch_capacity=16384,
+        batch_capacity=BENCH_CAPACITY,
         tt_bytes=512 << 20,
-        eval_sizes=(1024, 4096, 16384),
+        eval_sizes=tuple(
+            s for s in (1024, 4096, 16384, BENCH_CAPACITY) if s <= BENCH_CAPACITY
+        ),
     )
     import numpy as np
 
@@ -644,13 +722,31 @@ def main() -> None:
         # all-sentinel compile dummies can never be the capture.
         orig_eval = service._eval_fn
 
-        def capturing_eval(params, feats, buckets, parents, material):
-            if len(buckets) >= max(4096, len(captured.get("buckets", ()))):
+        def capturing_eval(params, packed, offsets, buckets, parents, material):
+            # Key the capture on REAL entries (non-sentinel fulls +
+            # deltas), not the padded bucket length: every large step
+            # ships the same bucket size, and keying on it let drain-
+            # tail batches (mostly padding) overwrite the steady-state
+            # capture the tier exists to price.
+            from fishnet_tpu.nnue import spec as _spec
+
+            p = np.asarray(parents)
+            off = np.clip(np.asarray(offsets), 0, len(packed) - 1)
+            first = np.asarray(packed)[off, 0, 0]
+            real_n = int(((p >= 0) | (first != _spec.NUM_FEATURES)).sum())
+            if real_n >= 4096 and real_n > captured.get("real_n", 0):
+                from fishnet_tpu.nnue.jax_eval import expand_packed_np
+
                 captured.update(
-                    feats=np.array(feats), buckets=np.array(buckets),
+                    feats=expand_packed_np(
+                        np.asarray(packed), np.asarray(offsets),
+                        np.asarray(parents),
+                    ).astype(np.int32),
+                    buckets=np.array(buckets),
                     parents=np.array(parents), material=np.array(material),
+                    packed_rows=len(packed), real_n=real_n,
                 )
-            return orig_eval(params, feats, buckets, parents, material)
+            return orig_eval(params, packed, offsets, buckets, parents, material)
 
         service._eval_fn = capturing_eval
         asyncio.run(run_searches(service, jobs[:8], 500))
@@ -710,6 +806,11 @@ def main() -> None:
     host = bench_host_scaling()
     log(f"bench: host scaling done in {time.perf_counter() - t:.1f}s: {host}")
 
+    log("bench: AZ/MCTS tier (batched PUCT)...")
+    t = time.perf_counter()
+    az = bench_az()
+    log(f"bench: az tier done in {time.perf_counter() - t:.1f}s: {az}")
+
     log("bench: search quality (scalar backend, transport-free)...")
     t = time.perf_counter()
     quality = bench_search_quality()
@@ -725,6 +826,7 @@ def main() -> None:
                 "transport": transport,
                 "device": device,
                 "host": host,
+                "az": az,
                 "traffic": traffic,
                 "search_quality": quality,
             }

@@ -203,3 +203,66 @@ def evaluate_batch(
 #: jit of evaluate_batch; ``parent=None`` (full entries only) and
 #: ``parent=array`` (may carry incremental entries) trace separately.
 evaluate_batch_jit = jax.jit(evaluate_batch)
+
+
+def expand_packed(
+    packed: jax.Array, offsets: jax.Array, parent: jax.Array
+) -> jax.Array:
+    """Expand the COMPACT WIRE FORMAT back to dense [B, 2, 32] indices.
+
+    ``packed`` [R, 2, 8] rows (any int dtype; uint16 on the wire from
+    cpp/src/pool.cpp emit_block), ``offsets`` int32 [B] row offsets:
+    a full entry (parent < 0) owns 4 consecutive rows — its 32 slots
+    per perspective, 8 at a time; a delta entry owns ONE row (its
+    2*DELTA_SLOTS live slots) and its slots [8, 32) are sentinel by
+    wire contract. Deltas therefore ship 32 bytes instead of 128 —
+    the host->device payload cut lands exactly on the entries
+    speculation multiplies (VERDICT r3 item 4).
+
+    The expansion is one gather + select on device (~sub-ms against a
+    multi-ms eval step); the dense array then feeds the unchanged
+    gather kernel, so packed and dense evaluation are bit-identical.
+    """
+    packed = packed.astype(jnp.int32)  # [R, 2, 8]
+    offsets = offsets.astype(jnp.int32)
+    rows = offsets[:, None] + jnp.arange(4, dtype=jnp.int32)[None, :]  # [B, 4]
+    rows = jnp.clip(rows, 0, packed.shape[0] - 1)
+    g = jnp.take(packed, rows, axis=0)  # [B, 4, 2, 8]
+    dense = jnp.transpose(g, (0, 2, 1, 3)).reshape(-1, 2, 4 * 8)  # [B, 2, 32]
+    # Delta entries: row 0 holds the live slots, the rest is sentinel.
+    sent = jnp.full(
+        (dense.shape[0], 2, 3 * 8), spec.NUM_FEATURES, jnp.int32
+    )
+    delta_dense = jnp.concatenate([dense[:, :, :8], sent], axis=2)
+    is_delta = (parent.astype(jnp.int32) >= 0)[:, None, None]
+    return jnp.where(is_delta, delta_dense, dense)
+
+
+def evaluate_packed(
+    params: Params,
+    packed: jax.Array,
+    offsets: jax.Array,
+    buckets: jax.Array,
+    parent: jax.Array,
+    material: Optional[jax.Array] = None,
+) -> jax.Array:
+    """evaluate_batch over the compact wire format (see expand_packed)."""
+    dense = expand_packed(packed, offsets, parent)
+    return evaluate_batch(params, dense, buckets, parent, material)
+
+
+evaluate_packed_jit = jax.jit(evaluate_packed)
+
+
+def expand_packed_np(packed, offsets, parent):
+    """NumPy twin of expand_packed, for hosts that must hand a DENSE
+    batch to an external evaluator (the sharded serving path and test
+    doubles take [B, 2, 32]; the native pool now always emits packed)."""
+    packed = np.ascontiguousarray(packed)
+    rows = offsets[:, None].astype(np.int64) + np.arange(4)
+    np.clip(rows, 0, len(packed) - 1, out=rows)
+    g = packed[rows]  # [B, 4, 2, 8]
+    dense = np.transpose(g, (0, 2, 1, 3)).reshape(-1, 2, 32).copy()
+    is_delta = np.asarray(parent) >= 0
+    dense[is_delta, :, 8:] = spec.NUM_FEATURES
+    return dense

@@ -87,7 +87,8 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
     ]
     lib.fc_pool_step.restype = ctypes.c_int
     lib.fc_pool_provide.argtypes = [
@@ -282,13 +283,13 @@ class SearchService:
                 import jax
 
                 from fishnet_tpu.nnue.jax_eval import (
-                    evaluate_batch_jit,
+                    evaluate_packed_jit,
                     params_from_weights,
                 )
 
                 w = weights if weights is not None else NnueWeights.load(net_path)
                 self._params = jax.device_put(params_from_weights(w))
-                self._eval_fn = evaluate_batch_jit
+                self._eval_fn = evaluate_packed_jit
 
         # Driver state. Buffers must exist before the thread starts.
         cap = batch_capacity
@@ -326,12 +327,19 @@ class SearchService:
             sizes.add(self._group_capacity)  # groups fill to this bucket
             self._eval_sizes = sorted({min(s, cap) for s in sizes})
             self._shard_align = 0
-        # uint16 feature indices: half the host->device transfer bytes.
+        # COMPACT WIRE: the pool emits a packed uint16 row stream (full
+        # entry = 4 rows of [2][8], delta entry = 1 row) plus int32 row
+        # offsets — deltas ship 32 bytes instead of 128 (VERDICT r3
+        # item 4). The built-in evaluator expands on DEVICE
+        # (jax_eval.expand_packed); external evaluators (sharded mesh,
+        # test doubles) receive the dense expansion host-side.
         # One buffer set per group: a group's buffers must stay
         # untouched while its dispatched eval is still in flight, and
         # each group is only ever touched by its owning thread.
         k = self._n_groups
-        self._feat_buf = np.empty((k, cap, 2, spec.MAX_ACTIVE_FEATURES), dtype=np.uint16)
+        self._packed_wire = backend == "jax" and evaluator is None
+        self._packed_buf = np.empty((k, 4 * cap + 4, 2, 8), dtype=np.uint16)
+        self._offset_buf = np.empty((k, cap), dtype=np.int32)
         self._bucket_buf = np.empty((k, cap), dtype=np.int32)
         self._slot_buf = np.empty((k, cap), dtype=np.int32)
         # Incremental-eval references (batch-relative parent codes; -1 =
@@ -351,6 +359,7 @@ class SearchService:
         # step that ships the 1k bucket is not "5% occupied".
         self._eval_steps = [0] * T
         self._bucket_slots = [0] * T
+        self._wire_bytes = [0] * T  # host->device payload actually shipped
         self._pending: List[Dict[int, _Pending]] = [{} for _ in range(T)]
         self._submissions: List[List[Tuple]] = [[] for _ in range(T)]
         self._cancelled_tokens: List[set] = [set() for _ in range(T)]
@@ -421,10 +430,21 @@ class SearchService:
             self._wakes[t].set()
             raise
 
+    def _row_tiers(self, size: int) -> List[int]:
+        """Packed-row shape buckets for an entry bucket of ``size``.
+        Rows range from ~size (all-delta) to 4*size (all-full) + the 4
+        shared sentinel pad rows; each tier is one XLA compile, so only
+        the LARGEST entry bucket (where the payload matters) gets the
+        finer tiers — small buckets are base-RTT-dominated anyway."""
+        if self._packed_wire and size == self._eval_sizes[-1]:
+            return [2 * size + 4, 3 * size + 4, 4 * size + 4]
+        return [4 * size + 4]
+
     def warmup(self) -> None:
-        """Compile every eval-size bucket with dummy data. Call before
-        timing anything: a first-touch compile mid-traffic stalls the
-        whole driver loop for seconds to minutes on tunneled devices."""
+        """Compile every (entry bucket x packed-row tier) with dummy
+        data. Call before timing anything: a first-touch compile
+        mid-traffic stalls the whole driver loop for seconds to minutes
+        on tunneled devices."""
         if self._eval_fn is None:
             return
         # Once-only and serialized: the driver thread warms up at start
@@ -434,17 +454,33 @@ class SearchService:
             if self._warmed:
                 return
             for s in self._eval_sizes:
-                if self._stopping:  # close() during startup: stop compiling
-                    return
-                feats = np.full(
-                    (s, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.uint16
-                )
-                bucks = np.zeros((s,), np.int32)
-                parents = np.full((s,), -1, np.int32)
-                material = np.zeros((s,), np.int32)
-                np.asarray(
-                    self._eval_fn(self._params, feats, bucks, parents, material)
-                )
+                for tier in self._row_tiers(s):
+                    if self._stopping:  # close() during startup
+                        return
+                    bucks = np.zeros((s,), np.int32)
+                    parents = np.full((s,), -1, np.int32)
+                    material = np.zeros((s,), np.int32)
+                    if self._packed_wire:
+                        packed = np.full(
+                            (tier, 2, 8), spec.NUM_FEATURES, np.uint16
+                        )
+                        offsets = np.zeros((s,), np.int32)
+                        np.asarray(
+                            self._eval_fn(
+                                self._params, packed, offsets, bucks,
+                                parents, material,
+                            )
+                        )
+                    else:
+                        feats = np.full(
+                            (s, 2, spec.MAX_ACTIVE_FEATURES),
+                            spec.NUM_FEATURES, np.uint16,
+                        )
+                        np.asarray(
+                            self._eval_fn(
+                                self._params, feats, bucks, parents, material
+                            )
+                        )
             self._warmed = True
 
     def poke(self) -> None:
@@ -493,9 +529,11 @@ class SearchService:
             "tt_eval_hits", "prefetch_budget", "delta_evals",
             "dedup_evals", "nodes",
         )[:n])}
-        # Service-side: slots actually transferred (size-bucketed).
+        # Service-side: slots actually transferred (size-bucketed) and
+        # host->device payload bytes shipped (the compact wire's metric).
         out["eval_steps"] = sum(self._eval_steps)
         out["bucket_slots"] = sum(self._bucket_slots)
+        out["wire_bytes"] = sum(self._wire_bytes)
         return out
 
     def is_alive(self) -> bool:
@@ -556,14 +594,15 @@ class SearchService:
 
     # -- evaluation -------------------------------------------------------
 
-    def _dispatch_eval(self, group: int, n: int):
+    def _dispatch_eval(self, group: int, n: int, rows: int):
         """Launch group `group`'s microbatch on the device WITHOUT waiting
         for the result — the returned jax array is resolved later by
         _resolve_eval, letting other groups' batches overlap this one's
         transfer and compute (the software pipeline's whole point).
 
-        Size-bucketed shapes: ship the smallest power-of-two slice that
-        covers n. Each bucket compiles once; a lightly-loaded step then
+        Size-bucketed shapes: ship the smallest slice covering n entries
+        and (packed path) the smallest row tier covering `rows`. Each
+        (bucket, tier) compiles once; a lightly-loaded step then
         transfers KBs, not the full batch_capacity buffer (the
         host->device link is the bottleneck resource)."""
         size = self._eval_sizes[-1]
@@ -574,16 +613,39 @@ class SearchService:
         t = group // self.pipeline_depth  # owning thread's telemetry cell
         self._eval_steps[t] += 1
         self._bucket_slots[t] += size
-        feats = self._feat_buf[group]
+        packed = self._packed_buf[group]
+        offsets = self._offset_buf[group]
         buckets = self._bucket_buf[group]
         parents = self._parent_buf[group]
         material = self._material_buf[group]
-        feats[n:size] = spec.NUM_FEATURES
+        # Padding entries: all share 4 sentinel rows appended past the
+        # emitted stream, decoding to all-sentinel full entries.
+        packed[rows : rows + 4] = spec.NUM_FEATURES
+        offsets[n:size] = rows
         buckets[n:size] = 0
         parents[n:size] = -1
         material[n:size] = 0
+        if self._packed_wire:
+            tier = self._row_tiers(size)[-1]
+            for rt in self._row_tiers(size):
+                if rows + 4 <= rt:
+                    tier = rt
+                    break
+            self._wire_bytes[t] += tier * 2 * 8 * 2 + size * 4 * 4
+            return self._eval_fn(
+                self._params, packed[:tier], offsets[:size], buckets[:size],
+                parents[:size], material[:size],
+            )
+        # External evaluator (sharded mesh, test doubles): hand it the
+        # dense expansion.
+        from fishnet_tpu.nnue.jax_eval import expand_packed_np
+
+        feats = expand_packed_np(
+            packed[: rows + 4], offsets[:size], parents[:size]
+        )
+        self._wire_bytes[t] += feats.nbytes + size * 3 * 4
         return self._eval_fn(
-            self._params, feats[:size], buckets[:size], parents[:size],
+            self._params, feats, buckets[:size], parents[:size],
             material[:size],
         )
 
@@ -616,8 +678,12 @@ class SearchService:
         # This thread's slot groups (disjoint from every other thread's).
         groups = range(t * self.pipeline_depth, (t + 1) * self.pipeline_depth)
         pending = self._pending[t]
-        feat_ptrs = {
-            g: self._feat_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+        packed_ptrs = {
+            g: self._packed_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+            for g in groups
+        }
+        offset_ptrs = {
+            g: self._offset_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
             for g in groups
         }
         bucket_ptrs = {
@@ -729,16 +795,18 @@ class SearchService:
                         n_prev,
                     )
                 # Advance this group's fibers; fill its eval batch.
+                rows = ctypes.c_int32()
                 n = lib.fc_pool_step(
-                    self._pool, g, feat_ptrs[g], bucket_ptrs[g], slot_ptrs[g],
+                    self._pool, g, packed_ptrs[g], offset_ptrs[g],
+                    bucket_ptrs[g], slot_ptrs[g],
                     parent_ptrs[g], material_ptrs[g], self._group_capacity,
-                    self._shard_align,
+                    self._shard_align, ctypes.byref(rows),
                 )
                 stepped += n
                 if n > 0:
                     if self._eval_fn is None:
                         raise NativeCoreError("no evaluator")  # pragma: no cover
-                    inflight[g] = (n, self._dispatch_eval(g, n))
+                    inflight[g] = (n, self._dispatch_eval(g, n, rows.value))
 
             # Harvest this thread's finished searches.
             for g in groups:

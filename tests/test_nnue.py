@@ -296,3 +296,65 @@ def test_verify_net_subcommand(tmp_path):
     lines = []
     assert not verify_net(str(bad), positions=5, depth=1, log=lines.append)
     assert any("FAIL" in l and "re-export" in l for l in lines)
+
+
+def test_packed_wire_matches_dense():
+    """The compact wire format (packed [R,2,8] rows + offsets; full
+    entry = 4 rows, delta entry = 1 row) must evaluate bit-identically
+    to the dense [B,2,32] layout it compresses."""
+    import numpy as np
+
+    from fishnet_tpu.nnue import spec
+    from fishnet_tpu.nnue.jax_eval import (
+        evaluate_batch,
+        evaluate_packed,
+        expand_packed_np,
+        params_from_weights,
+    )
+    from fishnet_tpu.nnue.weights import NnueWeights
+
+    rng = np.random.default_rng(11)
+    B = 48
+    dense = np.full((B, 2, 32), spec.NUM_FEATURES, np.uint16)
+    parent = np.full((B,), -1, np.int32)
+    packed_rows = []
+    offsets = np.zeros((B,), np.int32)
+    last_full = 0
+    for b in range(B):
+        offsets[b] = len(packed_rows)
+        is_delta = b % 4 != 0  # blocks of 1 full + 3 deltas
+        if not is_delta:
+            k = int(rng.integers(8, 31))
+            for p in range(2):
+                dense[b, p, :k] = np.sort(
+                    rng.choice(spec.NUM_FEATURES, k, replace=False)
+                )
+            last_full = b
+            for r in range(4):
+                packed_rows.append(dense[b, :, r * 8 : (r + 1) * 8])
+        else:
+            for p in range(2):
+                dense[b, p, :2] = rng.choice(spec.NUM_FEATURES, 2, replace=False)
+                dense[b, p, spec.DELTA_SLOTS : spec.DELTA_SLOTS + 2] = (
+                    spec.DELTA_BASE + rng.choice(spec.NUM_FEATURES, 2, replace=False)
+                )
+                dense[b, p, spec.DELTA_SLOTS + 2 : 2 * spec.DELTA_SLOTS] = (
+                    spec.DELTA_BASE + spec.NUM_FEATURES
+                )
+            parent[b] = (last_full << 1) | int(rng.integers(0, 2))
+            packed_rows.append(dense[b, :, :8])
+    packed = np.stack(packed_rows).astype(np.uint16)
+    buckets = rng.integers(0, 8, B).astype(np.int32)
+    material = rng.integers(-2000, 2000, B).astype(np.int32)
+
+    params = params_from_weights(NnueWeights.random(seed=13))
+    want = np.asarray(evaluate_batch(params, dense, buckets, parent, material))
+    got = np.asarray(
+        evaluate_packed(params, packed, offsets, buckets, parent, material)
+    )
+    assert (want == got).all()
+    # The NumPy expansion twin (used for external evaluators) agrees too.
+    np.testing.assert_array_equal(
+        expand_packed_np(packed, offsets, parent).astype(np.int32),
+        dense.astype(np.int32),
+    )
