@@ -282,9 +282,41 @@ async def run_client(opt: Opt, logger: Logger) -> None:
     except NotImplementedError:  # non-Unix
         pass
 
+    # Periodic auto-update (main.rs:179-199): every 5 h re-check the
+    # release channel; an installed update drains work (shutdown_soon ->
+    # wait_drained resolves the supervisor wait) and the restart happens
+    # after teardown below — the reference's drain-then-exec, exactly.
+    restart_to: Optional[str] = None
+    staged_update = None
+
+    async def update_loop() -> None:
+        nonlocal restart_to, staged_update
+        from fishnet_tpu.update import UPDATE_INTERVAL_SECONDS, apply_update
+
+        while True:
+            await asyncio.sleep(UPDATE_INTERVAL_SECONDS)
+            try:
+                status = await apply_update(
+                    logger=logger, allow_default=True, defer_promote=True
+                )
+            except Exception as err:  # noqa: BLE001 - keep serving on failures
+                logger.error(f"Periodic update check failed: {err}")
+                continue
+            if status.updated:
+                logger.fishnet_info(
+                    f"Update {status.latest} staged; draining before restart ..."
+                )
+                restart_to = status.latest
+                staged_update = status.staged
+                client.shutdown_soon()
+                return
+
     logger.fishnet_info(f"fishnet-tpu {__version__} connecting to {opt.resolved_endpoint()}")
     await client.start()
     summary = asyncio.create_task(client.run_summary_loop())
+    updater = (
+        asyncio.create_task(update_loop()) if opt.auto_update else None
+    )
     # Exit on explicit stop (second ^C / SIGTERM) OR when a first-^C
     # drain completes on its own (main.rs:248-259).
     stop_task = asyncio.create_task(stop.wait())
@@ -292,14 +324,35 @@ async def run_client(opt: Opt, logger: Logger) -> None:
     try:
         await asyncio.wait({stop_task, drained_task}, return_when=asyncio.FIRST_COMPLETED)
     finally:
-        for t in (stop_task, drained_task, summary):
-            t.cancel()
+        for t in (stop_task, drained_task, summary, updater):
+            if t is not None:
+                t.cancel()
         await client.stop(abort_pending=stop.is_set())
         # Tear down shared engine backends before interpreter exit: a
         # daemon driver thread still inside native/JAX code when Python
         # unwinds takes the process down with SIGABRT.
         engine_factory.close()
         logger.fishnet_info(client.stats_summary())
+        # Promote + restart only on the drain path: an explicit operator
+        # stop (second ^C / SIGTERM) during the post-update drain must
+        # actually stop — resurrecting a unit systemd just killed is
+        # worse than missing one update cycle. Promotion happens HERE,
+        # after the engines are torn down, so no live process ever has
+        # files swapped under it (update.py promote_staged).
+        if restart_to is not None and not stop.is_set():
+            from fishnet_tpu.update import (
+                default_install_root,
+                promote_staged,
+                restart_process,
+            )
+
+            if staged_update is not None:
+                try:
+                    promote_staged(staged_update, default_install_root())
+                except Exception as err:  # noqa: BLE001
+                    logger.error(f"Update promotion failed: {err}")
+                    return
+            restart_process(logger, restart_to)
 
 
 def main(argv=None) -> int:

@@ -5,20 +5,40 @@ Equivalent of the reference's ``--auto-update`` flow
 and every UPDATE_INTERVAL, and when a newer version exists, finish
 draining work and re-``exec`` the process so the new code takes over.
 
-The reference self-replaces a static binary from an S3 bucket; a Python
-deployment updates its environment instead, so the update *source* is
-pluggable: ``FISHNET_TPU_UPDATE_URL`` names an HTTP JSON index
-``{"latest": "x.y.z", "command": ["pip", ...]}`` (absent ⇒ updates are a
-no-op). The drain-then-exec restart semantics are preserved exactly.
+The reference self-replaces a static binary from an S3 bucket
+(src/main.rs:440-464, bucket ``fishnet-releases``); the equivalent here
+is a DEFAULT static-HTTPS release channel with the same S3-compatible
+layout, used whenever ``--auto-update`` is set: a JSON index names the
+latest version plus a release tarball and its sha256; the tarball is
+downloaded, hash-verified, and unpacked over the installation root
+before the drain-then-exec restart. ``FISHNET_TPU_UPDATE_URL``
+overrides the channel (private mirrors, the integration tests); the
+index may alternatively carry a ``command`` (e.g. a pip install) for
+environments that manage their own packages.
+
+Index schema, served at ``<channel>/index.json``::
+
+    {"latest": "x.y.z",
+     "artifact": "vX.Y.Z/fishnet-tpu-vX.Y.Z.tar.gz",   # urljoin vs index
+     "sha256": "<hex digest of the tarball>",
+     "command": ["pip", "install", ...]}                # legacy alternative
+
+The artifact layout is exactly what CI packages (.github/workflows/
+build.yml: ``fishnet_tpu/`` + prebuilt ``cpp/libfishnetcore*.so`` tiers
++ sources).
 """
 
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import os
 import sys
+import tarfile
+import tempfile
 from dataclasses import dataclass
+from pathlib import Path
 from typing import List, Optional
 
 from fishnet_tpu.utils.logger import Logger
@@ -29,6 +49,15 @@ from fishnet_tpu.version import __version__
 UPDATE_INTERVAL_SECONDS = 5 * 60 * 60
 
 UPDATE_URL_ENV = "FISHNET_TPU_UPDATE_URL"
+
+#: Default release channel (S3-compatible static HTTPS, the layout the
+#: reference's self_update consumes from its own bucket). Engaged only
+#: when the caller opts in (--auto-update passes allow_default=True);
+#: the env override always wins.
+DEFAULT_CHANNEL = (
+    "https://fishnet-tpu-releases.s3.dualstack.eu-west-3.amazonaws.com"
+    "/fishnet-tpu"
+)
 
 
 def parse_version(v: str) -> tuple:
@@ -42,18 +71,33 @@ class UpdateStatus:
     latest: Optional[str] = None
     updated: bool = False
     command: Optional[List[str]] = None
+    #: Release-tarball channel fields (the default path): artifact URL
+    #: resolved against the index URL, and its required sha256.
+    artifact: Optional[str] = None
+    sha256: Optional[str] = None
+    #: Verified, fully-extracted staging directory awaiting promotion
+    #: (set when apply_update ran with defer_promote=True).
+    staged: Optional[Path] = None
 
     @property
     def update_available(self) -> bool:
         return self.latest is not None and parse_version(self.latest) > parse_version(self.current)
 
 
-async def check_for_update(url: Optional[str] = None) -> UpdateStatus:
-    """Fetch the release index (one GET; the command rides along so
-    apply_update doesn't re-fetch a possibly changed index). Returns
-    ``checked=False`` when no update source is configured (the common,
-    zero-egress deployment)."""
-    url = url or os.environ.get(UPDATE_URL_ENV)
+async def check_for_update(
+    url: Optional[str] = None, allow_default: bool = False
+) -> UpdateStatus:
+    """Fetch the release index (one GET; artifact/command ride along so
+    apply_update doesn't re-fetch a possibly changed index). Source
+    precedence: explicit ``url`` > ``FISHNET_TPU_UPDATE_URL`` > the
+    default channel (only with ``allow_default``, i.e. --auto-update).
+    Returns ``checked=False`` when no source applies (the common
+    zero-egress deployment without --auto-update)."""
+    from urllib.parse import urljoin
+
+    url = url or os.environ.get(UPDATE_URL_ENV) or (
+        DEFAULT_CHANNEL + "/index.json" if allow_default else None
+    )
     if not url:
         return UpdateStatus(checked=False, current=__version__)
     import aiohttp
@@ -62,30 +106,135 @@ async def check_for_update(url: Optional[str] = None) -> UpdateStatus:
         async with session.get(url, timeout=aiohttp.ClientTimeout(total=30)) as resp:
             resp.raise_for_status()
             index = json.loads(await resp.text())
+    artifact = index.get("artifact")
     return UpdateStatus(
         checked=True,
         current=__version__,
         latest=index.get("latest"),
         command=index.get("command"),
+        artifact=urljoin(url, artifact) if artifact else None,
+        sha256=index.get("sha256"),
     )
 
 
-async def apply_update(url: Optional[str] = None, logger: Optional[Logger] = None) -> UpdateStatus:
-    """Check and, when newer, run the index's update command
-    (e.g. a pip install). Restart is the caller's job — after draining,
-    like main.rs:257-259."""
+def default_install_root() -> Path:
+    """Where release tarballs unpack: the directory containing the
+    ``fishnet_tpu`` package (the tarball carries ``fishnet_tpu/``,
+    ``cpp/...`` at its top level — CI's artifact layout)."""
+    return Path(__file__).resolve().parent.parent
+
+
+async def download_and_verify(
+    artifact_url: str, sha256: str, dest: Path
+) -> Path:
+    """Stream the release tarball to ``dest`` and require the announced
+    sha256 — a mismatched or truncated download must never be unpacked
+    (the integrity guarantee the reference gets from its signed
+    self_update artifacts)."""
+    import aiohttp
+
+    digest = hashlib.sha256()
+    tmp = dest.with_suffix(".part")
+    async with aiohttp.ClientSession() as session:
+        async with session.get(
+            artifact_url, timeout=aiohttp.ClientTimeout(total=600)
+        ) as resp:
+            resp.raise_for_status()
+            with open(tmp, "wb") as f:
+                async for chunk in resp.content.iter_chunked(1 << 16):
+                    digest.update(chunk)
+                    f.write(chunk)
+    if digest.hexdigest() != sha256.lower():
+        tmp.unlink(missing_ok=True)
+        raise ValueError(
+            f"release artifact hash mismatch: got {digest.hexdigest()}, "
+            f"index announced {sha256}"
+        )
+    tmp.rename(dest)
+    return dest
+
+
+def install_tarball(tar_path: Path, staging: Path) -> None:
+    """Unpack a verified release tarball into a STAGING directory.
+    ``filter='data'`` rejects path traversal, links, and device nodes
+    outright (the 'all engine input is carefully validated' stance of
+    the reference, applied to our own update channel). Staging keeps a
+    mid-extract failure (disk full, rejected member) from leaving the
+    live tree mixed-version — nothing touches it until promote_staged.
+    """
+    with tarfile.open(tar_path, "r:gz") as tar:
+        tar.extractall(staging, filter="data")
+
+
+def promote_staged(staging: Path, install_root: Path) -> None:
+    """Move a fully-extracted staging tree into place, one atomic
+    os.replace per file. Rename (not truncate-in-place) is what keeps a
+    still-running process safe: its dlopen'ed native libraries and
+    imported modules hold the OLD inodes, which persist unlinked until
+    process exit — extracting directly over the live tree would
+    truncate mapped .so files and SIGBUS the engine mid-drain. Callers
+    promote only when idle: at startup (nothing loaded yet) or after
+    the drain completes, right before the exec restart."""
+    for src in sorted(staging.rglob("*")):
+        if not src.is_file():
+            continue
+        dest = install_root / src.relative_to(staging)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        os.replace(src, dest)
+    import shutil
+
+    shutil.rmtree(staging, ignore_errors=True)
+
+
+async def apply_update(
+    url: Optional[str] = None,
+    logger: Optional[Logger] = None,
+    allow_default: bool = False,
+    install_root: Optional[Path] = None,
+    defer_promote: bool = False,
+) -> UpdateStatus:
+    """Check and, when newer, install: download + sha256-verify + unpack
+    the release tarball into staging (default channel), or run the
+    index's update command (legacy/pip deployments). With
+    ``defer_promote`` the verified staging dir is returned in
+    ``status.staged`` instead of being promoted — the periodic updater
+    promotes only after the drain completes, so the live process never
+    has files swapped under it while work is in flight. Restart is the
+    caller's job — after draining, like main.rs:257-259."""
     logger = logger or Logger()
-    status = await check_for_update(url)
+    status = await check_for_update(url, allow_default=allow_default)
     if not status.checked:
         logger.debug("Auto-update: no update source configured.")
         return status
     if not status.update_available:
         logger.fishnet_info(f"fishnet-tpu {__version__} is up to date.")
         return status
-    command = status.command
-    if command:
+    if status.artifact and status.sha256:
         logger.fishnet_info(f"Updating to {status.latest} ...")
-        proc = await asyncio.create_subprocess_exec(*command)
+        root = install_root or default_install_root()
+        staging = root / f".fishnet-tpu-staging-{status.latest}"
+        with tempfile.TemporaryDirectory(prefix="fishnet-tpu-update-") as td:
+            try:
+                tar = await download_and_verify(
+                    status.artifact, status.sha256,
+                    Path(td) / "release.tar.gz",
+                )
+                install_tarball(tar, staging)
+            except Exception as err:  # noqa: BLE001 - keep running on bad updates
+                logger.error(f"Update download/verify failed: {err}")
+                import shutil
+
+                shutil.rmtree(staging, ignore_errors=True)
+                return status
+        if defer_promote:
+            status.staged = staging
+        else:
+            promote_staged(staging, root)
+        status.updated = True
+        return status
+    if status.command:
+        logger.fishnet_info(f"Updating to {status.latest} ...")
+        proc = await asyncio.create_subprocess_exec(*status.command)
         rc = await proc.wait()
         if rc != 0:
             logger.error(f"Update command failed with exit code {rc}.")
@@ -111,10 +260,12 @@ def restart_process(logger: Logger, target_version: Optional[str] = None) -> Non
 
 def auto_update(logger: Logger) -> UpdateStatus:
     """Startup-time check (main.rs:48-65). Blocking wrapper; the periodic
-    re-check runs inside the supervisor loop via ``check_for_update``."""
+    re-check runs inside the supervisor loop via ``check_for_update``.
+    --auto-update is the opt-in that engages the DEFAULT release channel
+    (env override still wins inside check_for_update)."""
     logger.fishnet_info("Checking for updates (--auto-update) ...")
     try:
-        status = asyncio.run(apply_update(logger=logger))
+        status = asyncio.run(apply_update(logger=logger, allow_default=True))
     except Exception as err:
         logger.error(f"Failed to check for updates: {err}")
         return UpdateStatus(checked=False, current=__version__)

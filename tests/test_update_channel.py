@@ -1,0 +1,138 @@
+"""Default release-channel auto-update: check -> download -> sha256
+verify -> unpack (reference src/main.rs:440-464, the S3 self_update
+flow). Served by a local aiohttp app standing in for the S3-compatible
+static channel."""
+
+import hashlib
+import io
+import json
+import tarfile
+
+import pytest
+from aiohttp import web
+
+from fishnet_tpu import update as update_mod
+
+pytestmark = pytest.mark.anyio
+
+
+def make_release_tarball() -> bytes:
+    """A minimal release artifact in CI's layout (fishnet_tpu/ at the
+    top level)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        data = b"__version__ = '99.0.0'\n"
+        info = tarfile.TarInfo("fishnet_tpu/_release_marker.py")
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+class FakeChannel:
+    """Static-HTTPS release channel fixture: /index.json + the tarball."""
+
+    def __init__(self, latest="99.0.0", sha256=None, tarball=None):
+        self.tarball = tarball if tarball is not None else make_release_tarball()
+        self.sha256 = sha256 or hashlib.sha256(self.tarball).hexdigest()
+        self.latest = latest
+        self.index_hits = 0
+        self.artifact_hits = 0
+
+    async def __aenter__(self):
+        app = web.Application()
+        app.router.add_get("/channel/index.json", self._index)
+        app.router.add_get(
+            "/channel/v99.0.0/fishnet-tpu.tar.gz", self._artifact
+        )
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.base = f"http://127.0.0.1:{port}/channel"
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.runner.cleanup()
+
+    async def _index(self, request):
+        self.index_hits += 1
+        return web.json_response(
+            {
+                "latest": self.latest,
+                "artifact": "v99.0.0/fishnet-tpu.tar.gz",
+                "sha256": self.sha256,
+            }
+        )
+
+    async def _artifact(self, request):
+        self.artifact_hits += 1
+        return web.Response(body=self.tarball)
+
+
+async def test_check_download_verify_install(tmp_path, monkeypatch):
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    async with FakeChannel() as ch:
+        status = await update_mod.apply_update(
+            url=f"{ch.base}/index.json", install_root=tmp_path
+        )
+        assert status.checked and status.update_available
+        assert status.updated
+        assert ch.index_hits == 1 and ch.artifact_hits == 1
+        marker = tmp_path / "fishnet_tpu" / "_release_marker.py"
+        assert marker.read_bytes() == b"__version__ = '99.0.0'\n"
+
+
+async def test_hash_mismatch_refuses_install(tmp_path, monkeypatch):
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    async with FakeChannel(sha256="0" * 64) as ch:
+        status = await update_mod.apply_update(
+            url=f"{ch.base}/index.json", install_root=tmp_path
+        )
+        assert status.checked and status.update_available
+        assert not status.updated  # verification failed -> nothing unpacked
+        assert not (tmp_path / "fishnet_tpu").exists()
+
+
+async def test_default_channel_engages_only_with_auto_update(monkeypatch):
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    async with FakeChannel(latest="0.0.1") as ch:  # older: no install
+        monkeypatch.setattr(update_mod, "DEFAULT_CHANNEL", ch.base)
+        # Without the opt-in there is no update source at all.
+        status = await update_mod.check_for_update()
+        assert not status.checked
+        # --auto-update (allow_default) reads the default channel.
+        status = await update_mod.check_for_update(allow_default=True)
+        assert status.checked and status.latest == "0.0.1"
+        assert not status.update_available
+
+
+async def test_env_override_beats_default_channel(monkeypatch):
+    async with FakeChannel(latest="0.0.2") as ch:
+        monkeypatch.setattr(
+            update_mod, "DEFAULT_CHANNEL", "http://127.0.0.1:1/nowhere"
+        )
+        monkeypatch.setenv(update_mod.UPDATE_URL_ENV, f"{ch.base}/index.json")
+        status = await update_mod.check_for_update(allow_default=True)
+        assert status.checked and status.latest == "0.0.2"
+
+
+async def test_traversal_artifact_rejected(tmp_path, monkeypatch):
+    """A malicious tarball with a path-escaping member must not write
+    outside the install root (tarfile filter='data')."""
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        data = b"evil"
+        info = tarfile.TarInfo("../escape.txt")
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+    evil = buf.getvalue()
+    async with FakeChannel(tarball=evil) as ch:
+        root = tmp_path / "root"
+        root.mkdir()
+        status = await update_mod.apply_update(
+            url=f"{ch.base}/index.json", install_root=root
+        )
+        assert not status.updated
+        assert not (tmp_path / "escape.txt").exists()
