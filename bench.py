@@ -303,8 +303,8 @@ def bench_az() -> dict:
     pool = MctsPool(params, cfg)
     pool.warmup()
 
-    visits = int(_os.environ.get("FISHNET_BENCH_AZ_VISITS", 300))
-    n_searches = int(_os.environ.get("FISHNET_BENCH_AZ_SEARCHES", 64))
+    visits = int(_os.environ.get("FISHNET_BENCH_AZ_VISITS", 150))
+    n_searches = int(_os.environ.get("FISHNET_BENCH_AZ_SEARCHES", 32))
     sids = [
         pool.submit(FENS[i % len(FENS)], [], visits=visits)
         for i in range(n_searches)
@@ -324,7 +324,7 @@ def bench_az() -> dict:
         total_visits += pool.harvest(sid).visits
 
     # Quality probe: one deeper search of a fixed tactical position.
-    probe_sid = pool.submit(FENS[3], [], visits=4 * visits)
+    probe_sid = pool.submit(FENS[3], [], visits=2 * visits)
     while pool.active() > 0:
         pool.step()
     probe = pool.harvest(probe_sid)

@@ -286,11 +286,10 @@ async def run_client(opt: Opt, logger: Logger) -> None:
     # release channel; an installed update drains work (shutdown_soon ->
     # wait_drained resolves the supervisor wait) and the restart happens
     # after teardown below — the reference's drain-then-exec, exactly.
-    restart_to: Optional[str] = None
-    staged_update = None
+    restart_to = None  # UpdateStatus of a staged, deferred install
 
     async def update_loop() -> None:
-        nonlocal restart_to, staged_update
+        nonlocal restart_to
         from fishnet_tpu.update import UPDATE_INTERVAL_SECONDS, apply_update
 
         while True:
@@ -306,8 +305,7 @@ async def run_client(opt: Opt, logger: Logger) -> None:
                 logger.fishnet_info(
                     f"Update {status.latest} staged; draining before restart ..."
                 )
-                restart_to = status.latest
-                staged_update = status.staged
+                restart_to = status
                 client.shutdown_soon()
                 return
 
@@ -333,26 +331,37 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         # unwinds takes the process down with SIGABRT.
         engine_factory.close()
         logger.fishnet_info(client.stats_summary())
-        # Promote + restart only on the drain path: an explicit operator
-        # stop (second ^C / SIGTERM) during the post-update drain must
-        # actually stop — resurrecting a unit systemd just killed is
-        # worse than missing one update cycle. Promotion happens HERE,
-        # after the engines are torn down, so no live process ever has
-        # files swapped under it (update.py promote_staged).
-        if restart_to is not None and not stop.is_set():
-            from fishnet_tpu.update import (
-                default_install_root,
-                promote_staged,
-                restart_process,
-            )
+    # Promote + restart only on a clean drain with no operator stop
+    # intent: a second ^C / SIGTERM (stop) or even a single ^C (drain
+    # then EXIT) must actually stop — resurrecting a unit systemd just
+    # killed is worse than missing one update cycle. Deliberately after
+    # the try/finally (never inside it: a `return` there would swallow
+    # an in-flight CancelledError). The install lands HERE, once the
+    # engines are torn down, so no live process ever has files swapped
+    # under it (update.py promote_staged).
+    if restart_to is not None and not stop.is_set() and sigints == 0:
+        from fishnet_tpu.update import (
+            default_install_root,
+            promote_staged,
+            restart_process,
+        )
 
-            if staged_update is not None:
-                try:
-                    promote_staged(staged_update, default_install_root())
-                except Exception as err:  # noqa: BLE001
-                    logger.error(f"Update promotion failed: {err}")
-                    return
-            restart_process(logger, restart_to)
+        ok = True
+        if restart_to.staged is not None:
+            try:
+                promote_staged(restart_to.staged, default_install_root())
+            except Exception as err:  # noqa: BLE001
+                logger.error(f"Update promotion failed: {err}")
+                ok = False
+        elif restart_to.command:
+            import subprocess
+
+            rc = subprocess.run(restart_to.command).returncode
+            if rc != 0:
+                logger.error(f"Update command failed with exit code {rc}.")
+                ok = False
+        if ok:
+            restart_process(logger, restart_to.latest)
 
 
 def main(argv=None) -> int:

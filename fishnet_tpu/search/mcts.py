@@ -316,10 +316,24 @@ class MctsPool:
 
     def __init__(self, params: Dict, cfg: MctsConfig = MctsConfig()) -> None:
         import jax
+        import jax.numpy as jnp
 
         self.cfg = cfg
         self.params = params
-        self._forward = jax.jit(lambda p, x: az_forward(p, x, cfg.az))
+
+        # Tunnel-aware wire format: planes ship as uint8 (they are 0/1
+        # masks except the halfmove plane, which rides x100 as an
+        # integer and is decoded in-graph) and the policy logits return
+        # as float16 — ~3x less host<->device payload per step, which
+        # on a latency+payload-priced link is most of a step's cost.
+        # Values stay float32 (one scalar per leaf).
+        def forward(p, x_u8):
+            x = x_u8.astype(jnp.float32)
+            x = x.at[..., 17].multiply(1.0 / 100.0)
+            logits, values = az_forward(p, x, cfg.az)
+            return logits.astype(jnp.float16), values
+
+        self._forward = jax.jit(forward)
         self._searches: Dict[int, _Search] = {}
         self._next_id = 0
         self._rr_cursor = 0
@@ -327,7 +341,7 @@ class MctsPool:
 
     def warmup(self) -> None:
         cap = self.cfg.batch_capacity
-        planes = np.zeros((cap, 8, 8, 19), np.float32)
+        planes = np.zeros((cap, 8, 8, 19), np.uint8)
         logits, values = self._forward(self.params, planes)
         np.asarray(values)
 
@@ -382,11 +396,14 @@ class MctsPool:
         if not planes_list:
             return 0
 
-        batch = np.zeros((cap, 8, 8, 19), np.float32)
-        batch[: len(planes_list)] = np.stack(planes_list)
+        batch = np.zeros((cap, 8, 8, 19), np.uint8)
+        stacked = np.stack(planes_list)
+        u8 = stacked.astype(np.uint8)
+        u8[..., 17] = np.rint(stacked[..., 17] * 100.0)
+        batch[: len(planes_list)] = u8
         logits, values = self._forward(self.params, batch)
         n_used = len(planes_list)
-        logits = np.asarray(logits[:n_used])
+        logits = np.asarray(logits[:n_used], dtype=np.float32)
         values = np.asarray(values[:n_used])
 
         cursor = 0

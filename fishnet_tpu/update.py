@@ -78,6 +78,10 @@ class UpdateStatus:
     #: Verified, fully-extracted staging directory awaiting promotion
     #: (set when apply_update ran with defer_promote=True).
     staged: Optional[Path] = None
+    #: True when defer_promote postponed the actual install (staged
+    #: tarball promotion OR legacy command execution) to the caller's
+    #: post-drain path.
+    deferred: bool = False
 
     @property
     def update_available(self) -> bool:
@@ -174,10 +178,28 @@ def promote_staged(staging: Path, install_root: Path) -> None:
     process exit — extracting directly over the live tree would
     truncate mapped .so files and SIGBUS the engine mid-drain. Callers
     promote only when idle: at startup (nothing loaded yet) or after
-    the drain completes, right before the exec restart."""
-    for src in sorted(staging.rglob("*")):
-        if not src.is_file():
-            continue
+    the drain completes, right before the exec restart.
+
+    A validation pre-pass rejects file/directory type collisions BEFORE
+    any file moves, so the common mid-walk failures cannot leave a
+    mixed-version tree (a crash mid-promotion still can — per-file
+    rename is as atomic as a portable install gets)."""
+    files = [p for p in sorted(staging.rglob("*")) if p.is_file()]
+    for src in files:
+        rel = src.relative_to(staging)
+        dest = install_root / rel
+        if dest.exists() and dest.is_dir():
+            raise IsADirectoryError(
+                f"release file {rel} collides with an existing directory"
+            )
+        probe = install_root
+        for part in rel.parts[:-1]:
+            probe = probe / part
+            if probe.exists() and not probe.is_dir():
+                raise NotADirectoryError(
+                    f"release path {rel} crosses existing file {probe}"
+                )
+    for src in files:
         dest = install_root / src.relative_to(staging)
         dest.parent.mkdir(parents=True, exist_ok=True)
         os.replace(src, dest)
@@ -213,6 +235,12 @@ async def apply_update(
         logger.fishnet_info(f"Updating to {status.latest} ...")
         root = install_root or default_install_root()
         staging = root / f".fishnet-tpu-staging-{status.latest}"
+        import shutil
+
+        # A previous run may have staged this version and been stopped
+        # before promoting; extracting over the stale tree would merge
+        # files a re-cut artifact no longer contains.
+        shutil.rmtree(staging, ignore_errors=True)
         with tempfile.TemporaryDirectory(prefix="fishnet-tpu-update-") as td:
             try:
                 tar = await download_and_verify(
@@ -228,11 +256,19 @@ async def apply_update(
                 return status
         if defer_promote:
             status.staged = staging
+            status.deferred = True
         else:
             promote_staged(staging, root)
         status.updated = True
         return status
     if status.command:
+        if defer_promote:
+            # The live environment must not be mutated while work is in
+            # flight: the caller runs the command after its drain, like
+            # the tarball promotion.
+            status.deferred = True
+            status.updated = True
+            return status
         logger.fishnet_info(f"Updating to {status.latest} ...")
         proc = await asyncio.create_subprocess_exec(*status.command)
         rc = await proc.wait()

@@ -135,4 +135,45 @@ async def test_traversal_artifact_rejected(tmp_path, monkeypatch):
             url=f"{ch.base}/index.json", install_root=root
         )
         assert not status.updated
+        # A successful traversal from the staging dir would land at
+        # root/escape.txt; filter='data' must reject the member.
+        assert not (root / "escape.txt").exists()
         assert not (tmp_path / "escape.txt").exists()
+
+
+async def test_defer_promote_stages_without_touching_root(tmp_path, monkeypatch):
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    async with FakeChannel() as ch:
+        status = await update_mod.apply_update(
+            url=f"{ch.base}/index.json", install_root=tmp_path,
+            defer_promote=True,
+        )
+        assert status.updated and status.deferred
+        assert status.staged is not None and status.staged.exists()
+        # Nothing promoted yet: the live root has no package files.
+        assert not (tmp_path / "fishnet_tpu").exists()
+        update_mod.promote_staged(status.staged, tmp_path)
+        assert (tmp_path / "fishnet_tpu" / "_release_marker.py").exists()
+        assert not status.staged.exists()  # staging consumed
+
+
+async def test_defer_promote_defers_legacy_command(monkeypatch, tmp_path):
+    """A command-index update must NOT run the command mid-flight when
+    the caller asked for deferral (the live environment would be
+    mutated while work drains)."""
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    marker = tmp_path / "ran"
+
+    class CommandChannel(FakeChannel):
+        async def _index(self, request):
+            return web.json_response(
+                {"latest": "99.0.0", "command": ["touch", str(marker)]}
+            )
+
+    async with CommandChannel() as ch:
+        status = await update_mod.apply_update(
+            url=f"{ch.base}/index.json", defer_promote=True
+        )
+        assert status.updated and status.deferred
+        assert status.command == ["touch", str(marker)]
+        assert not marker.exists()  # not run; caller runs it post-drain
