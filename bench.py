@@ -285,6 +285,48 @@ def bench_realized_mix(params, captured: dict) -> dict:
     return out
 
 
+def bench_frc() -> dict:
+    """Chess960 analysis through the batched TPU-NNUE path
+    (BASELINE.json config 3): a handful of FRC start positions searched
+    concurrently on the jax backend — proves castling-rights handling
+    and the batched path end-to-end at bench level, and records a small
+    aggregate rate."""
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.search.service import SearchService
+
+    frc_fens = [
+        # Shredder-FEN castling (file letters), distinct FRC setups.
+        "bqnb1rkr/pppppppp/8/8/8/8/PPPPPPPP/BQNB1RKR w HFhf - 0 1",
+        "nrbbqnkr/pppppppp/8/8/8/8/PPPPPPPP/NRBBQNKR w HBhb - 0 1",
+        "rkbbnnqr/pppppppp/8/8/8/8/PPPPPPPP/RKBBNNQR w HAha - 0 1",
+        "qrknrnbb/pppppppp/8/8/8/8/PPPPPPPP/QRKNRNBB w EBeb - 0 1",
+    ]
+    svc = SearchService(
+        weights=NnueWeights.random(seed=7), pool_slots=64,
+        batch_capacity=256, tt_bytes=64 << 20, backend="jax",
+    )
+    try:
+        svc.warmup()
+
+        async def run():
+            t0 = time.perf_counter()
+            results = await asyncio.gather(
+                *[svc.search(fen, [], nodes=4000) for fen in frc_fens * 4]
+            )
+            dt = max(time.perf_counter() - t0, 1e-9)
+            nodes = sum(r.nodes for r in results)
+            return {
+                "positions": len(results),
+                "nodes": nodes,
+                "nps": round(nodes / dt),
+                "all_moves_found": all(r.best_move for r in results),
+            }
+
+        return asyncio.run(run())
+    finally:
+        svc.close()
+
+
 def bench_az() -> dict:
     """AZ/MCTS tier (BASELINE.json config 5; VERDICT r3 weak #5 — the
     batched-PUCT path had correctness tests but no performance
@@ -542,6 +584,26 @@ def bench_search_quality() -> dict:
         "depth_150k_median": mat["depth_150k_median"],
         "deep_search": mat["deep_search"],
     }
+    # BASELINE.json config 4: a deep user-queue job at go nodes 5000000
+    # (full policy; the scalar tier is the transport-free venue — a
+    # single search has no batch to amortize the tunnel against).
+    svc = SearchService(
+        weights=material_weights(), pool_slots=4,
+        batch_capacity=64, tt_bytes=512 << 20, backend="scalar",
+    )
+    try:
+        async def deep5m():
+            t0 = time.perf_counter()
+            r = await svc.search(FENS[6], [], nodes=5_000_000)
+            dt = max(time.perf_counter() - t0, 1e-9)
+            return {
+                "nodes": r.nodes, "depth": r.depth,
+                "scalar_nps": round(r.nodes / dt),
+            }
+
+        out["deep_5m"] = asyncio.run(deep5m())
+    finally:
+        svc.close()
     return out
 
 
@@ -811,6 +873,11 @@ def main() -> None:
     az = bench_az()
     log(f"bench: az tier done in {time.perf_counter() - t:.1f}s: {az}")
 
+    log("bench: Chess960 (FRC) through the batched path...")
+    t = time.perf_counter()
+    frc = bench_frc()
+    log(f"bench: frc tier done in {time.perf_counter() - t:.1f}s: {frc}")
+
     log("bench: search quality (scalar backend, transport-free)...")
     t = time.perf_counter()
     quality = bench_search_quality()
@@ -827,6 +894,7 @@ def main() -> None:
                 "device": device,
                 "host": host,
                 "az": az,
+                "frc": frc,
                 "traffic": traffic,
                 "search_quality": quality,
             }

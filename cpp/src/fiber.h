@@ -18,6 +18,12 @@
 #include <cstdlib>
 #include <functional>
 
+// macOS has no MAP_STACK (Linux uses it as a hint for stack mappings;
+// omitting it is semantically fine everywhere).
+#ifndef MAP_STACK
+#define MAP_STACK 0
+#endif
+
 namespace fc {
 
 class Fiber {
