@@ -540,6 +540,15 @@ def bench_search_quality() -> dict:
     from fishnet_tpu.nnue.weights import NnueWeights
     from fishnet_tpu.search.service import SearchService
 
+    async def timed_deep(svc, fen, nodes):
+        t0 = time.perf_counter()
+        r = await svc.search(fen, [], nodes=nodes)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        return {
+            "nodes": r.nodes, "depth": r.depth,
+            "scalar_nps": round(r.nodes / dt),
+        }
+
     def measure(weights):
         svc = SearchService(
             weights=weights, pool_slots=16,
@@ -559,13 +568,7 @@ def bench_search_quality() -> dict:
                     depths[mid] if len(depths) % 2 else
                     (depths[mid - 1] + depths[mid]) / 2
                 )
-                t0 = time.perf_counter()
-                r = await svc.search(FENS[3], [], nodes=1_500_000)
-                dt = time.perf_counter() - t0
-                out["deep_search"] = {
-                    "nodes": r.nodes, "depth": r.depth,
-                    "scalar_nps": round(r.nodes / max(dt, 1e-9)),
-                }
+                out["deep_search"] = await timed_deep(svc, FENS[3], 1_500_000)
                 return out
 
             return asyncio.run(run())
@@ -592,16 +595,7 @@ def bench_search_quality() -> dict:
         batch_capacity=64, tt_bytes=512 << 20, backend="scalar",
     )
     try:
-        async def deep5m():
-            t0 = time.perf_counter()
-            r = await svc.search(FENS[6], [], nodes=5_000_000)
-            dt = max(time.perf_counter() - t0, 1e-9)
-            return {
-                "nodes": r.nodes, "depth": r.depth,
-                "scalar_nps": round(r.nodes / dt),
-            }
-
-        out["deep_5m"] = asyncio.run(deep5m())
+        out["deep_5m"] = asyncio.run(timed_deep(svc, FENS[6], 5_000_000))
     finally:
         svc.close()
     return out
