@@ -50,7 +50,7 @@ async def test_concurrent_searches_two_threads():
         svc.close()
 
 
-async def test_two_threads_match_one_thread_results():
+async def _thread_determinism_sweep(fens):
     """Thread-count must not change WHAT a search computes, only where
     it runs: identical submissions, sequentially awaited (so the shared
     TT evolves deterministically), give identical scores/moves for 1 and
@@ -61,7 +61,7 @@ async def test_two_threads_match_one_thread_results():
         svc.set_prefetch(8, adaptive=False)
         try:
             out = []
-            for fen in FENS:
+            for fen in fens:
                 r = await svc.search(fen, [], depth=4)
                 line = [l for l in r.lines if l.multipv == 1][-1]
                 out.append((line.value, line.is_mate, r.best_move))
@@ -69,6 +69,17 @@ async def test_two_threads_match_one_thread_results():
         finally:
             svc.close()
     assert outs[1] == outs[2]
+
+
+async def test_two_threads_match_one_thread_results():
+    # Commit-gate smoke (3 positions); the full set incl. the promotion
+    # tactic and the kiwipete middlegame runs in the slow venue below.
+    await _thread_determinism_sweep(FENS[:3])
+
+
+@pytest.mark.slow
+async def test_two_threads_match_one_thread_results_full():
+    await _thread_determinism_sweep(FENS)
 
 
 async def test_shared_tt_thrash_across_threads():

@@ -406,9 +406,9 @@ async def test_scalar_vs_jax_depth4_score_parity():
     speculative entries exist only in the batched run and can tip a
     victim choice under pressure) stay out of reach.
 
-    Default-gate smoke: 30 positions (VERDICT r3 weak #4); the full
-    150-position sweep is test_scalar_vs_jax_depth4_parity_full behind
-    the `slow` marker."""
+    Default-gate smoke: 30 positions (the size VERDICT r3 weak #4
+    prescribes for the commit gate); the full 150-position sweep is
+    test_scalar_vs_jax_depth4_parity_full behind the `slow` marker."""
     await _depth4_parity_sweep(_random_fens(30, seed=77))
 
 
