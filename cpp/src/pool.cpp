@@ -746,8 +746,16 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_packed,
         }
         int budget = pool->prefetch_budget.load(std::memory_order_relaxed);
         int next = budget;
-        if (!pool->roi_ok || overflow) {
+        if (!pool->roi_ok) {
+          // Not earning: collapse fast (the periodic probe re-earns).
           next = budget / 2;
+        } else if (overflow) {
+          // Capacity pressure with GOOD ROI: back off gently — the
+          // compact wire prices speculative delta slots at a quarter of
+          // a full entry, so the equilibrium should sit near capacity
+          // rather than sawtooth far below it (measured r4: /2 decay
+          // pinned the budget at 5-7 against a 40-slot ceiling).
+          next = std::max(0, budget - 1 - budget / 8);
         } else if (int(batch.size()) + EVAL_BLOCK_MAX <= capacity &&
                    budget < EVAL_BLOCK_MAX) {
           // Growth keys on BUCKET HEADROOM (another maximal block would
