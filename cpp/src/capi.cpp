@@ -39,7 +39,7 @@ extern "C" {
 // change; the Python binder refuses mismatched libraries (a stale
 // prebuilt tier .so with an old layout would otherwise corrupt memory
 // through shifted arguments).
-int fc_abi_version() { return 4; }
+int fc_abi_version() { return 5; }
 
 int fc_init() {
   init_bitboards();
@@ -137,6 +137,16 @@ NnueNet* fc_nnue_load(const char* path, char* err, int errlen) {
 }
 
 void fc_nnue_free(NnueNet* net) { delete net; }
+
+// Incremental-eval cache handles, for the cached-vs-fresh parity tests
+// (the search uses a thread_local cache internally; tests need an
+// explicit one to drive deterministic sequences through).
+NnueEvalCache* fc_nnue_cache_new() { return new (std::nothrow) NnueEvalCache(); }
+void fc_nnue_cache_free(NnueEvalCache* cache) { delete cache; }
+int fc_nnue_evaluate_cached_test(const NnueNet* net, const Position* pos,
+                                 NnueEvalCache* cache) {
+  return nnue_evaluate_cached(*net, *pos, *cache);
+}
 
 int fc_nnue_material_correlated(const NnueNet* net) {
   return nnue_material_correlated(*net) ? 1 : 0;

@@ -90,47 +90,64 @@ int nnue_features(const Position& pos, Color perspective, T* out) {
 template int nnue_features<int32_t>(const Position&, Color, int32_t*);
 template int nnue_features<uint16_t>(const Position&, Color, uint16_t*);
 
-int nnue_evaluate(const NnueNet& net, const Position& pos) {
-  int32_t acc[COLOR_NB][NNUE_L1];
-  int32_t psqt[COLOR_NB][NNUE_PSQT_BUCKETS];
+namespace {
 
-  Color stm = pos.stm;
-  for (int p = 0; p < COLOR_NB; p++) {
-    Color perspective = p == 0 ? stm : ~stm;  // stm first
-    int32_t feats[NNUE_MAX_ACTIVE];
-    int n = nnue_features(pos, perspective, feats);
-
-    for (int i = 0; i < NNUE_L1; i++) acc[p][i] = net.ft_bias[i];
-    for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) psqt[p][b] = 0;
-    // The gather is MEMORY-latency bound, not ALU bound (the adds all
-    // vectorize to AVX-512; ~30 random 2 KB rows of a 46 MB table are
-    // ~30 cold-miss streams per perspective — the host-side twin of the
-    // device kernel's DMA-count bound). Prefetch every FOURTH cache
-    // line of the next row while accumulating the current one: enough
-    // to prime the hardware stream prefetcher for the lines between,
-    // without flooding the prefetch queue (measured 17.4 -> 4.3 us/eval;
-    // a full every-line prefetch measured ~4.8 us — queue pressure).
-    for (int j = 0; j < n; j++) {
-      if (j + 1 < n) {
-        const char* nxt = reinterpret_cast<const char*>(
-            &net.ft_weight[size_t(feats[j + 1]) * NNUE_L1]);
-        for (int l = 0; l < int(NNUE_L1 * sizeof(int16_t)); l += 256)
-          __builtin_prefetch(nxt + l);
-        __builtin_prefetch(&net.ft_psqt[size_t(feats[j + 1]) * NNUE_PSQT_BUCKETS]);
-      }
-      const int16_t* row = &net.ft_weight[size_t(feats[j]) * NNUE_L1];
-      for (int i = 0; i < NNUE_L1; i++) acc[p][i] += row[i];
-      const int32_t* prow = &net.ft_psqt[size_t(feats[j]) * NNUE_PSQT_BUCKETS];
-      for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) psqt[p][b] += prow[b];
+// Rebuild one color's perspective accumulator + PSQT from scratch.
+// The gather is MEMORY-latency bound, not ALU bound (the adds all
+// vectorize to AVX-512; ~30 random 2 KB rows of a 46 MB table are
+// ~30 cold-miss streams per perspective — the host-side twin of the
+// device kernel's DMA-count bound). Prefetch every FOURTH cache
+// line of the next row while accumulating the current one: enough
+// to prime the hardware stream prefetcher for the lines between,
+// without flooding the prefetch queue (measured 17.4 -> 4.3 us/eval;
+// a full every-line prefetch measured ~4.8 us — queue pressure).
+void rebuild_perspective(const NnueNet& net, const Position& pos, Color c,
+                         int32_t* acc, int32_t* psqt) {
+  int32_t feats[NNUE_MAX_ACTIVE];
+  int n = nnue_features(pos, c, feats);
+  for (int i = 0; i < NNUE_L1; i++) acc[i] = net.ft_bias[i];
+  for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) psqt[b] = 0;
+  for (int j = 0; j < n; j++) {
+    if (j + 1 < n) {
+      const char* nxt = reinterpret_cast<const char*>(
+          &net.ft_weight[size_t(feats[j + 1]) * NNUE_L1]);
+      for (int l = 0; l < int(NNUE_L1 * sizeof(int16_t)); l += 256)
+        __builtin_prefetch(nxt + l);
+      __builtin_prefetch(&net.ft_psqt[size_t(feats[j + 1]) * NNUE_PSQT_BUCKETS]);
     }
+    const int16_t* row = &net.ft_weight[size_t(feats[j]) * NNUE_L1];
+    for (int i = 0; i < NNUE_L1; i++) acc[i] += row[i];
+    const int32_t* prow = &net.ft_psqt[size_t(feats[j]) * NNUE_PSQT_BUCKETS];
+    for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) psqt[b] += prow[b];
   }
+}
 
-  // Pairwise clipped multiply, stm perspective first.
+// Apply one feature row to a perspective accumulator, signed.
+void apply_row(const NnueNet& net, int idx, int sign, int32_t* acc,
+               int32_t* psqt) {
+  const int16_t* row = &net.ft_weight[size_t(idx) * NNUE_L1];
+  const int32_t* prow = &net.ft_psqt[size_t(idx) * NNUE_PSQT_BUCKETS];
+  if (sign > 0) {
+    for (int i = 0; i < NNUE_L1; i++) acc[i] += row[i];
+    for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) psqt[b] += prow[b];
+  } else {
+    for (int i = 0; i < NNUE_L1; i++) acc[i] -= row[i];
+    for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) psqt[b] -= prow[b];
+  }
+}
+
+// The dense tail shared by the fresh and cached paths: clipped pairwise
+// multiply over the stm-ordered accumulators, then the layer stacks and
+// the material term.
+int eval_tail(const NnueNet& net, const Position& pos,
+              const int32_t* acc_stm, const int32_t* acc_opp,
+              const int32_t* psqt_stm, const int32_t* psqt_opp) {
+  const int32_t* accs[COLOR_NB] = {acc_stm, acc_opp};
   uint8_t x[NNUE_L1];
   for (int p = 0; p < COLOR_NB; p++) {
     for (int i = 0; i < NNUE_L1_HALF; i++) {
-      int32_t a = clamp32(acc[p][i], 0, 127);
-      int32_t b = clamp32(acc[p][i + NNUE_L1_HALF], 0, 127);
+      int32_t a = clamp32(accs[p][i], 0, 127);
+      int32_t b = clamp32(accs[p][i + NNUE_L1_HALF], 0, 127);
       x[p * NNUE_L1_HALF + i] = uint8_t((a * b) >> 7);
     }
   }
@@ -171,11 +188,85 @@ int nnue_evaluate(const NnueNet& net, const Position& pos) {
   int32_t v = net.out_bias[bucket];
   for (int i = 0; i < NNUE_L3; i++) v += int32_t(orow[i]) * z[i];
 
-  int32_t material = (psqt[0][bucket] - psqt[1][bucket]) / 2;
+  int32_t material = (psqt_stm[bucket] - psqt_opp[bucket]) / 2;
   // skip * 9600 / 8128, reduced to stay within int32 (= skip + skip*23/127;
   // exact under C truncation since skip*8128/8128 has no remainder).
   int32_t positional = v + skip + (skip * 23) / 127;
   return (positional + material) / 16;
+}
+
+}  // namespace
+
+int nnue_evaluate(const NnueNet& net, const Position& pos) {
+  int32_t acc[COLOR_NB][NNUE_L1];
+  int32_t psqt[COLOR_NB][NNUE_PSQT_BUCKETS];
+  Color stm = pos.stm;
+  rebuild_perspective(net, pos, stm, acc[0], psqt[0]);
+  rebuild_perspective(net, pos, ~stm, acc[1], psqt[1]);
+  return eval_tail(net, pos, acc[0], acc[1], psqt[0], psqt[1]);
+}
+
+int nnue_evaluate_cached(const NnueNet& net, const Position& pos,
+                         NnueEvalCache& cache) {
+  int8_t cur[64];
+  for (int s = 0; s < 64; s++) cur[s] = int8_t(pos.piece_on(Square(s)));
+  Square ks[COLOR_NB] = {pos.king_sq(WHITE), pos.king_sq(BLACK)};
+
+  if (cache.net_uid == net.uid) {
+    // Piece diff vs the cached position. Consecutive evals in a
+    // depth-first search are usually 1-2 moves apart: 2-6 touched
+    // squares. Beyond MAX_DIFF a rebuild is no slower than the deltas.
+    // INVARIANT TWIN: cpp/src/pool.cpp fill_delta encodes the same
+    // rules for the DEVICE delta path (64-square before/after diff,
+    // remove-then-add via nnue_feature_index, own-king-moved => full
+    // rebuild of that perspective, diff-cap => rebuild) — a change to
+    // either must be mirrored in the other, and the cached-vs-fresh
+    // parity test plus the scalar-vs-jax search parity suites fail if
+    // they drift.
+    constexpr int MAX_DIFF = 8;
+    int dsq[MAX_DIFF];
+    int nd = 0;
+    bool too_many = false;
+    for (int s = 0; s < 64 && !too_many; s++) {
+      if (cur[s] == cache.piece_on[s]) continue;
+      if (nd >= MAX_DIFF) {
+        too_many = true;
+        break;
+      }
+      dsq[nd++] = s;
+    }
+    for (int c = 0; c < COLOR_NB; c++) {
+      if (too_many || ks[c] != cache.ksq[c]) {
+        // An own-king move rebases every feature of this perspective
+        // (king buckets + mirroring): rebuild. The OPPONENT's king
+        // moving is just a piece diff here, handled below.
+        rebuild_perspective(net, pos, Color(c), cache.acc[c], cache.psqt[c]);
+        continue;
+      }
+      for (int d = 0; d < nd; d++) {
+        Square s = Square(dsq[d]);
+        int before = cache.piece_on[s];
+        int after = cur[s];
+        if (before != NO_PIECE)
+          apply_row(net, nnue_feature_index(ks[c], Color(c), before, s), -1,
+                    cache.acc[c], cache.psqt[c]);
+        if (after != NO_PIECE)
+          apply_row(net, nnue_feature_index(ks[c], Color(c), after, s), +1,
+                    cache.acc[c], cache.psqt[c]);
+      }
+    }
+  } else {
+    rebuild_perspective(net, pos, WHITE, cache.acc[WHITE], cache.psqt[WHITE]);
+    rebuild_perspective(net, pos, BLACK, cache.acc[BLACK], cache.psqt[BLACK]);
+  }
+  memcpy(cache.piece_on, cur, sizeof(cur));
+  cache.ksq[WHITE] = ks[WHITE];
+  cache.ksq[BLACK] = ks[BLACK];
+  cache.net_uid = net.uid;
+
+  Color stm = pos.stm;
+  return eval_tail(net, pos, cache.acc[stm], cache.acc[~stm],
+                   cache.psqt[stm], cache.psqt[~stm]);
 }
 
 bool nnue_material_correlated(const NnueNet& net) {

@@ -5,6 +5,7 @@
 
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <string>
 #include <vector>
@@ -33,7 +34,13 @@ constexpr int NNUE_PSQT_BUCKETS = 8;
 constexpr int NNUE_L2 = 15;
 constexpr int NNUE_L3 = 32;
 
+//: Process-unique id per loaded net: NnueEvalCache validates against it
+//: instead of the net's address, which a fresh allocation could alias
+//: after a pool teardown (stale accumulators for a different net).
+inline std::atomic<uint64_t> nnue_net_uid_counter{0};
+
 struct NnueNet {
+  const uint64_t uid = ++nnue_net_uid_counter;
   std::vector<int16_t> ft_weight;  // [FEATURES][L1]
   std::vector<int16_t> ft_bias;    // [L1]
   std::vector<int32_t> ft_psqt;    // [FEATURES][PSQT_BUCKETS]
@@ -84,6 +91,29 @@ inline int nnue_psqt_bucket(const Position& pos) {
 
 // Full evaluation in centipawns from the side-to-move's point of view.
 int nnue_evaluate(const NnueNet& net, const Position& pos);
+
+// Incremental-evaluation cache: the previously evaluated position's
+// piece placement and COLOR-INDEXED (white=0/black=1, not stm-relative)
+// feature-transformer + PSQT accumulators. Consecutive evals in a
+// depth-first search are usually one or two moves apart, so the next
+// accumulator is the cached one plus a handful of row adds/subtracts —
+// the host-side twin of the device batch's delta entries, and exactly
+// as bit-exact (integer adds commute). A moved king rebases every
+// feature of that color's perspective (HalfKA king buckets/mirroring),
+// so such evals rebuild that perspective in full.
+struct NnueEvalCache {
+  uint64_t net_uid = 0;  // 0 = invalid (uids start at 1)
+  int8_t piece_on[64];
+  Square ksq[COLOR_NB];
+  int32_t acc[COLOR_NB][NNUE_L1];
+  int32_t psqt[COLOR_NB][NNUE_PSQT_BUCKETS];
+};
+
+// nnue_evaluate through a caller-owned incremental cache. Bit-identical
+// to nnue_evaluate for every position (verified by tests over random
+// game sequences including castling, promotions, en passant).
+int nnue_evaluate_cached(const NnueNet& net, const Position& pos,
+                         NnueEvalCache& cache);
 
 // Does this net's eval track material? Probes a handful of fixed
 // positions with one side's queen/rook deleted and checks the eval

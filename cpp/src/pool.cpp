@@ -164,7 +164,10 @@ void fill_full(Slot* slot, const NnueNet* net, int j, const Position& pos) {
 // valid while each perspective's king is on the same square in both
 // positions — a moved king re-bases every feature of that perspective
 // (HalfKA king buckets + mirroring), so such entries fall back to a
-// full fill. Typical delta: 1-3 rows per region vs ~30 for a full fill
+// full fill. INVARIANT TWIN: cpp/src/nnue.cpp nnue_evaluate_cached
+// applies the same rules host-side for the scalar search's incremental
+// accumulator — keep the two in lockstep (the parity suites catch
+// drift). Typical delta: 1-3 rows per region vs ~30 for a full fill
 // — a ~4x cut in row DMAs for the prefetch-block children that
 // dominate batch traffic (one move touches at most 2 adds / 3 removes:
 // mover or promotion to-piece, plus from-square, victim, e.p. pawn).

@@ -63,7 +63,16 @@ class EvalBridge {
 class ScalarEval : public EvalBridge {
  public:
   explicit ScalarEval(const NnueNet* net) : net_(net) {}
-  int evaluate(const Position& pos) override { return nnue_evaluate(*net_, pos); }
+  // Incremental path: consecutive evals on one scheduler thread come
+  // from one depth-first search (scalar searches run to completion
+  // inside a single pool step), so the thread-local cache's previous
+  // position is almost always 1-2 moves away — a handful of row
+  // updates instead of a ~60-row gather. Bit-identical to the fresh
+  // eval; the cache validates against the net's process-unique id.
+  int evaluate(const Position& pos) override {
+    static thread_local NnueEvalCache cache;
+    return nnue_evaluate_cached(*net_, pos, cache);
+  }
 
  private:
   const NnueNet* net_;

@@ -358,3 +358,58 @@ def test_packed_wire_matches_dense():
         expand_packed_np(packed, offsets, parent).astype(np.int32),
         dense.astype(np.int32),
     )
+
+
+def test_cached_eval_matches_fresh_over_game_sequences():
+    """nnue_evaluate_cached must be bit-identical to the fresh eval over
+    arbitrary eval sequences — including castling (own-king rebuild),
+    promotions, en passant, and jumps between unrelated positions."""
+    import ctypes
+    import random
+    import tempfile
+
+    from fishnet_tpu.chess import Board
+    from fishnet_tpu.chess.core import load
+    from fishnet_tpu.nnue.weights import NnueWeights
+
+    lib = load()
+    if not hasattr(lib.fc_nnue_evaluate_cached_test, "_bound"):
+        lib.fc_nnue_evaluate_cached_test.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.fc_nnue_evaluate_cached_test.restype = ctypes.c_int
+        lib.fc_nnue_cache_new.restype = ctypes.c_void_p
+        lib.fc_nnue_cache_free.argtypes = [ctypes.c_void_p]
+        lib.fc_nnue_evaluate.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.fc_nnue_evaluate.restype = ctypes.c_int
+        lib.fc_nnue_evaluate_cached_test._bound = True
+
+    w = NnueWeights.random(seed=17)
+    with tempfile.NamedTemporaryFile(suffix=".nnue") as f:
+        w.save(f.name)
+        err = ctypes.create_string_buffer(256)
+        net = lib.fc_nnue_load(f.name.encode(), err, len(err))
+        assert net, err.value
+        cache = lib.fc_nnue_cache_new()
+        try:
+            rng = random.Random(5)
+            b = Board()
+            checked = 0
+            for step in range(400):
+                if b.outcome() != 0 or rng.random() < 0.02:
+                    # Jump to an unrelated position: large diff / rebuild.
+                    b = Board()
+                    for _ in range(rng.randrange(0, 30)):
+                        if b.outcome() != 0:
+                            break
+                        b.push_uci(rng.choice(b.legal_moves()))
+                else:
+                    b.push_uci(rng.choice(b.legal_moves()))
+                fresh = lib.fc_nnue_evaluate(net, b._pos)
+                cached = lib.fc_nnue_evaluate_cached_test(net, b._pos, cache)
+                assert fresh == cached, (step, b.fen())
+                checked += 1
+            assert checked == 400
+        finally:
+            lib.fc_nnue_cache_free(cache)
+            lib.fc_nnue_free(net)
