@@ -399,7 +399,10 @@ class MctsPool:
         batch = np.zeros((cap, 8, 8, 19), np.uint8)
         stacked = np.stack(planes_list)
         u8 = stacked.astype(np.uint8)
-        u8[..., 17] = np.rint(stacked[..., 17] * 100.0)
+        # Clip before the uint8 assignment: halfmove clocks above 2.55
+        # (clock > 255 in arbitrary analysis FENs) would otherwise wrap
+        # modulo 256 and silently corrupt the plane.
+        u8[..., 17] = np.clip(np.rint(stacked[..., 17] * 100.0), 0, 255)
         batch[: len(planes_list)] = u8
         logits, values = self._forward(self.params, batch)
         n_used = len(planes_list)

@@ -9,19 +9,25 @@ The reference self-replaces a static binary from an S3 bucket
 (src/main.rs:440-464, bucket ``fishnet-releases``); the equivalent here
 is a DEFAULT static-HTTPS release channel with the same S3-compatible
 layout, used whenever ``--auto-update`` is set: a JSON index names the
-latest version plus a release tarball and its sha256; the tarball is
-downloaded, hash-verified, and unpacked over the installation root
-before the drain-then-exec restart. ``FISHNET_TPU_UPDATE_URL``
-overrides the channel (private mirrors, the integration tests); the
-index may alternatively carry a ``command`` (e.g. a pip install) for
-environments that manage their own packages.
+latest version plus a release tarball, its sha256, and a detached
+Ed25519 signature over the tarball made with the release-signing key
+(whose PUBLIC half is pinned below). The tarball is downloaded,
+hash-verified, signature-verified, and unpacked over the installation
+root before the drain-then-exec restart. The sha256 alone only protects
+against truncation — it comes from the same unauthenticated index, so
+the pinned-key signature is what makes bucket compromise ≠ RCE.
+``FISHNET_TPU_UPDATE_URL`` overrides the channel (private mirrors, the
+integration tests); only then may the index alternatively carry a
+``command`` (e.g. a pip install) for environments that manage their own
+packages — the default channel NEVER executes index-supplied commands.
 
 Index schema, served at ``<channel>/index.json``::
 
     {"latest": "x.y.z",
      "artifact": "vX.Y.Z/fishnet-tpu-vX.Y.Z.tar.gz",   # urljoin vs index
      "sha256": "<hex digest of the tarball>",
-     "command": ["pip", "install", ...]}                # legacy alternative
+     "signature": "<hex Ed25519 sig over the tarball bytes>",
+     "command": ["pip", "install", ...]}   # env-override channels only
 
 The artifact layout is exactly what CI packages (.github/workflows/
 build.yml: ``fishnet_tpu/`` + prebuilt ``cpp/libfishnetcore*.so`` tiers
@@ -59,6 +65,38 @@ DEFAULT_CHANNEL = (
     "/fishnet-tpu"
 )
 
+#: Ed25519 public key pinned in the client; the private half lives only
+#: in the release pipeline's secret store (tools/sign_release.py is the
+#: signing side). Artifacts from the DEFAULT channel must verify against
+#: this key — a compromised bucket can then serve stale or broken
+#: indexes, but not code we will execute. Override channels may supply
+#: their own key via FISHNET_TPU_UPDATE_PUBKEY (hex).
+SIGNING_PUBKEY_HEX = (
+    "e7aa856c36f1f3f9b2a415b9d1bef208f5ceacdc9b0ecefb993a36a46c6e7733"
+)
+
+UPDATE_PUBKEY_ENV = "FISHNET_TPU_UPDATE_PUBKEY"
+
+
+def verify_signature(data: bytes, signature_hex: str, pubkey_hex: str) -> None:
+    """Raise if ``signature_hex`` is not a valid Ed25519 signature over
+    ``data`` by ``pubkey_hex``. Fails loudly (ImportError) when the
+    ``cryptography`` package is absent — a signature we cannot check is
+    treated exactly like a bad one."""
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+
+    key = Ed25519PublicKey.from_public_bytes(bytes.fromhex(pubkey_hex))
+    try:
+        key.verify(bytes.fromhex(signature_hex), data)
+    except InvalidSignature:
+        raise ValueError(
+            "release artifact signature does not verify against the "
+            "pinned release key"
+        ) from None
+
 
 def parse_version(v: str) -> tuple:
     return tuple(int(p) for p in v.strip().lstrip("v").split("."))
@@ -72,9 +110,15 @@ class UpdateStatus:
     updated: bool = False
     command: Optional[List[str]] = None
     #: Release-tarball channel fields (the default path): artifact URL
-    #: resolved against the index URL, and its required sha256.
+    #: resolved against the index URL, its required sha256, and the
+    #: detached Ed25519 signature (required on the default channel).
     artifact: Optional[str] = None
     sha256: Optional[str] = None
+    signature: Optional[str] = None
+    #: True when the index came from the built-in DEFAULT channel (no
+    #: explicit url, no env override) — the trust decisions key off this:
+    #: signature mandatory, index `command` never executed.
+    from_default: bool = False
     #: Verified, fully-extracted staging directory awaiting promotion
     #: (set when apply_update ran with defer_promote=True).
     staged: Optional[Path] = None
@@ -99,9 +143,13 @@ async def check_for_update(
     zero-egress deployment without --auto-update)."""
     from urllib.parse import urljoin
 
-    url = url or os.environ.get(UPDATE_URL_ENV) or (
-        DEFAULT_CHANNEL + "/index.json" if allow_default else None
-    )
+    explicit = url or os.environ.get(UPDATE_URL_ENV)
+    from_default = False
+    if not explicit and allow_default:
+        url = DEFAULT_CHANNEL + "/index.json"
+        from_default = True
+    else:
+        url = explicit
     if not url:
         return UpdateStatus(checked=False, current=__version__)
     import aiohttp
@@ -118,6 +166,8 @@ async def check_for_update(
         command=index.get("command"),
         artifact=urljoin(url, artifact) if artifact else None,
         sha256=index.get("sha256"),
+        signature=index.get("signature"),
+        from_default=from_default,
     )
 
 
@@ -129,12 +179,17 @@ def default_install_root() -> Path:
 
 
 async def download_and_verify(
-    artifact_url: str, sha256: str, dest: Path
+    artifact_url: str, sha256: str, dest: Path,
+    signature: Optional[str] = None, pubkey_hex: Optional[str] = None,
+    require_signature: bool = False,
 ) -> Path:
-    """Stream the release tarball to ``dest`` and require the announced
-    sha256 — a mismatched or truncated download must never be unpacked
-    (the integrity guarantee the reference gets from its signed
-    self_update artifacts)."""
+    """Stream the release tarball to ``dest``; require the announced
+    sha256 (truncation/corruption guard) and — whenever a pubkey applies
+    — a valid Ed25519 signature over the tarball bytes. The sha256 comes
+    from the same unauthenticated index as the artifact, so only the
+    pinned-key signature authenticates the release; ``require_signature``
+    (the default channel) makes a missing signature fatal rather than
+    skippable."""
     import aiohttp
 
     digest = hashlib.sha256()
@@ -154,20 +209,59 @@ async def download_and_verify(
             f"release artifact hash mismatch: got {digest.hexdigest()}, "
             f"index announced {sha256}"
         )
+    if require_signature and not signature:
+        tmp.unlink(missing_ok=True)
+        raise ValueError(
+            "release index carries no signature; the default channel "
+            "requires artifacts signed by the pinned release key"
+        )
+    if signature and pubkey_hex:
+        try:
+            verify_signature(tmp.read_bytes(), signature, pubkey_hex)
+        except Exception:
+            tmp.unlink(missing_ok=True)
+            raise
     tmp.rename(dest)
     return dest
+
+
+def _validate_member(member: "tarfile.TarInfo") -> None:
+    """Manual stand-in for tarfile's ``filter='data'`` on interpreters
+    predating extraction filters (3.9–3.11 early patch levels): reject
+    path traversal, absolute names, links, and special files. Regular
+    files and directories only — exactly what CI's artifact layout
+    contains."""
+    name = member.name
+    if Path(name).is_absolute() or ".." in Path(name).parts:
+        raise ValueError(f"release member has unsafe path: {name!r}")
+    if not (member.isfile() or member.isdir()):
+        raise ValueError(
+            f"release member {name!r} is not a regular file or directory "
+            f"(type {member.type!r})"
+        )
+    # Match the 'data' filter's mode sanitization: no setuid/setgid/
+    # sticky, no group/other write, from an untrusted archive.
+    member.mode &= 0o755
 
 
 def install_tarball(tar_path: Path, staging: Path) -> None:
     """Unpack a verified release tarball into a STAGING directory.
     ``filter='data'`` rejects path traversal, links, and device nodes
     outright (the 'all engine input is carefully validated' stance of
-    the reference, applied to our own update channel). Staging keeps a
+    the reference, applied to our own update channel); interpreters
+    without extraction filters get the explicit member validation above
+    instead of silently failing every update cycle. Staging keeps a
     mid-extract failure (disk full, rejected member) from leaving the
     live tree mixed-version — nothing touches it until promote_staged.
     """
     with tarfile.open(tar_path, "r:gz") as tar:
-        tar.extractall(staging, filter="data")
+        if hasattr(tarfile, "data_filter"):
+            tar.extractall(staging, filter="data")
+        else:
+            members = tar.getmembers()
+            for m in members:
+                _validate_member(m)
+            tar.extractall(staging, members=members)
 
 
 def promote_staged(staging: Path, install_root: Path) -> None:
@@ -241,11 +335,25 @@ async def apply_update(
         # before promoting; extracting over the stale tree would merge
         # files a re-cut artifact no longer contains.
         shutil.rmtree(staging, ignore_errors=True)
+        # Default channel: the pinned key is mandatory. Override
+        # channels (tests, private mirrors): verify only when the
+        # operator configured a key for it.
+        pubkey = (
+            SIGNING_PUBKEY_HEX if status.from_default
+            else os.environ.get(UPDATE_PUBKEY_ENV)
+        )
+        # Wherever a key applies, an index that OMITS the signature must
+        # fail — otherwise a hostile mirror downgrades verification by
+        # simply not announcing one.
+        require_sig = status.from_default or bool(pubkey)
         with tempfile.TemporaryDirectory(prefix="fishnet-tpu-update-") as td:
             try:
                 tar = await download_and_verify(
                     status.artifact, status.sha256,
                     Path(td) / "release.tar.gz",
+                    signature=status.signature,
+                    pubkey_hex=pubkey,
+                    require_signature=require_sig,
                 )
                 install_tarball(tar, staging)
             except Exception as err:  # noqa: BLE001 - keep running on bad updates
@@ -262,6 +370,17 @@ async def apply_update(
         status.updated = True
         return status
     if status.command:
+        if status.from_default:
+            # Executing an index-supplied argv from the DEFAULT channel
+            # would turn bucket takeover into RCE on every --auto-update
+            # worker; only operator-configured channels (explicit url /
+            # FISHNET_TPU_UPDATE_URL) are trusted that far.
+            logger.error(
+                "Update index from the default channel carries a `command`; "
+                "refusing to execute it (only FISHNET_TPU_UPDATE_URL "
+                "channels may use command-based updates)."
+            )
+            return status
         if defer_promote:
             # The live environment must not be mutated while work is in
             # flight: the caller runs the command after its drain, like

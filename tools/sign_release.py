@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Release-signing helper — the OTHER half of fishnet_tpu.update's
+pinned-key verification.
+
+The CI release job holds the Ed25519 private key as a pipeline secret
+(never in the repo) and runs::
+
+    python tools/sign_release.py sign --key "$RELEASE_SIGNING_KEY_HEX" \
+        dist/fishnet-tpu-vX.Y.Z.tar.gz
+
+which prints the JSON fragment (``sha256`` + ``signature``) to merge
+into the channel's ``index.json``. ``keygen`` mints a fresh pair when
+rotating: the printed public half replaces
+``fishnet_tpu.update.SIGNING_PUBKEY_HEX`` in the next client release,
+the private half goes straight into the secret store.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+from pathlib import Path
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+RAW = serialization.Encoding.Raw
+
+
+def cmd_keygen(_args: argparse.Namespace) -> int:
+    key = Ed25519PrivateKey.generate()
+    priv = key.private_bytes(
+        RAW, serialization.PrivateFormat.Raw, serialization.NoEncryption()
+    )
+    pub = key.public_key().public_bytes(RAW, serialization.PublicFormat.Raw)
+    print(json.dumps({"private_hex": priv.hex(), "public_hex": pub.hex()}, indent=2))
+    print(
+        "\n# public_hex -> fishnet_tpu/update.py SIGNING_PUBKEY_HEX\n"
+        "# private_hex -> CI secret store ONLY (never commit)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def cmd_sign(args: argparse.Namespace) -> int:
+    data = Path(args.artifact).read_bytes()
+    key = Ed25519PrivateKey.from_private_bytes(bytes.fromhex(args.key))
+    sig = key.sign(data)
+    pub = key.public_key().public_bytes(RAW, serialization.PublicFormat.Raw)
+    print(
+        json.dumps(
+            {
+                "artifact": Path(args.artifact).name,
+                "sha256": hashlib.sha256(data).hexdigest(),
+                "signature": sig.hex(),
+                "signed_by": pub.hex(),
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("keygen", help="mint a new signing keypair")
+    sp = sub.add_parser("sign", help="sign a release tarball")
+    sp.add_argument("--key", required=True, help="private key hex (from secrets)")
+    sp.add_argument("artifact", help="release tarball path")
+    args = ap.parse_args()
+    return {"keygen": cmd_keygen, "sign": cmd_sign}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
