@@ -391,9 +391,11 @@ void fc_pool_free(SearchPool* pool) { delete pool; }
 // after a release), -2/-3 invalid fen/variant/moves, -4 fiber stack
 // exhaustion, -5 standard-variant search on a pool built without a
 // scalar net (a configuration error — resubmitting cannot clear it).
+// skill: engine strength −9..20; <20 enables the weakened best-move
+// sampling in Search::run (play jobs; analysis always passes 20).
 int fc_pool_submit(SearchPool* pool, int group, const char* fen,
                    const char* moves, uint64_t nodes, int depth, int multipv,
-                   int use_scalar, int variant) {
+                   int skill, int use_scalar, int variant) {
   if (group >= pool->n_groups) return -1;
   int id = -1;
   for (size_t i = group < 0 ? 0 : size_t(group); i < pool->slots.size();
@@ -437,6 +439,7 @@ int fc_pool_submit(SearchPool* pool, int group, const char* fen,
   slot.limits.nodes = nodes;
   slot.limits.depth = depth;
   slot.limits.multipv = multipv;
+  slot.limits.skill = std::max(-9, std::min(20, skill));
   slot.stop_requested = false;
   slot.abort_requested = false;
   slot.limits.stop = &slot.stop_requested;

@@ -18,7 +18,7 @@ struct LmrTable {
     for (int d = 0; d < 64; d++)
       for (int m = 0; m < 64; m++)
         r[d][m] = d && m
-                      ? int8_t(0.9 + std::log(double(d)) * std::log(double(m)) / 2.0)
+                      ? int8_t(0.8 + std::log(double(d)) * std::log(double(m)) / 1.75)
                       : 0;
   }
 };
@@ -55,7 +55,9 @@ TranspositionTable::TranspositionTable(size_t bytes) {
   size_t clusters = floor_pow2(
       std::max<size_t>(256, bytes / ((sizeof(Packed) + 2) * CLUSTER)));
   entries_ = std::vector<Packed>(clusters * CLUSTER);
-  gens_.assign(clusters * CLUSTER, 0);
+  // () value-initializes: atomic<uint16_t> has a trivial default ctor,
+  // so the array storage is zeroed.
+  gens_.reset(new std::atomic<uint16_t>[clusters * CLUSTER]());
   mask_ = clusters - 1;
 }
 
@@ -80,7 +82,7 @@ void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
                                int depth, TTBound bound) {
   constexpr auto R = std::memory_order_relaxed;
   Packed* c = cluster(key);
-  uint16_t* g = &gens_[(key & mask_) * CLUSTER];
+  std::atomic<uint16_t>* g = &gens_[(key & mask_) * CLUSTER];
   uint16_t gen = gen_.load(R);
   int idx = -1;
   TTData cur;
@@ -95,7 +97,7 @@ void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
   if (idx >= 0) {
     // Same position: depth-preferred within a generation, merging the
     // old best move / cached eval when the new store lacks them.
-    if (cur.bound != TT_NONE && g[idx] == gen && depth < cur.depth &&
+    if (cur.bound != TT_NONE && g[idx].load(R) == gen && depth < cur.depth &&
         bound != TT_EXACT)
       return;
     if (move == MOVE_NONE) move = cur.move;
@@ -109,7 +111,7 @@ void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
     int worst = 1 << 30;
     for (int i = 0; i < CLUSTER; i++) {
       TTData t = unpack(c[i].data.load(R));
-      int score = int(t.depth) + (g[i] == gen ? 512 : 0) +
+      int score = int(t.depth) + (g[i].load(R) == gen ? 512 : 0) +
                   (t.bound != TT_NONE ? 256 : 0);
       if (score < worst) {
         worst = score;
@@ -121,7 +123,7 @@ void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
     // entry, drop the store: under pressure, deep results are worth
     // more than this shallower one (measured — evicting them cost a
     // third of a ply at a 2 MiB table).
-    if (cur.bound != TT_NONE && g[idx] == gen && cur.depth > depth &&
+    if (cur.bound != TT_NONE && g[idx].load(R) == gen && cur.depth > depth &&
         bound != TT_EXACT)
       return;
   }
@@ -134,13 +136,13 @@ void TranspositionTable::store(uint64_t key, Move move, int value, int eval,
                     /*prefetched=*/false);
   c[idx].data.store(d, R);
   c[idx].kx.store(key ^ d, R);
-  g[idx] = gen;
+  g[idx].store(gen, R);
 }
 
 void TranspositionTable::store_eval(uint64_t key, int eval, bool speculative) {
   constexpr auto R = std::memory_order_relaxed;
   Packed* c = cluster(key);
-  uint16_t* g = &gens_[(key & mask_) * CLUSTER];
+  std::atomic<uint16_t>* g = &gens_[(key & mask_) * CLUSTER];
   uint16_t gen = gen_.load(R);
   // Victim ranking among bound-free slots (bound-carrying entries are
   // never evicted by a cheap static eval): empty beats unconsumed
@@ -167,7 +169,7 @@ void TranspositionTable::store_eval(uint64_t key, int eval, bool speculative) {
     if (occupied && t.bound != TT_NONE) continue;
     int rank = !occupied || t.eval == TT_EVAL_NONE ? 3  // empty
                : t.prefetched                      ? 2  // unconsumed speculation
-               : g[i] != gen                       ? 1  // stale cached eval
+               : g[i].load(R) != gen               ? 1  // stale cached eval
                                                    : 0;  // fresh demand eval: keep
     if (rank > victim_rank) {
       victim_rank = rank;
@@ -178,7 +180,7 @@ void TranspositionTable::store_eval(uint64_t key, int eval, bool speculative) {
     uint64_t d = pack(MOVE_NONE, 0, int16_t(eval), 0, TT_NONE, speculative);
     c[victim].data.store(d, R);
     c[victim].kx.store(key ^ d, R);
-    g[victim] = gen;
+    g[victim].store(gen, R);
   }
 }
 
@@ -356,11 +358,11 @@ int Search::quiet_history(const Position& pos, Move m, int ply) const {
     int pc = moving_piece(pos, m);
     Square to = move_to(m);
     if (ply >= 1 && ply <= MAX_PLY && move_stack_[ply] != MOVE_NONE)
-      score += *shared_->cont1.slot(piece_stack_[ply],
-                                    move_to(move_stack_[ply]), pc, to);
+      score += shared_->cont1.read(piece_stack_[ply],
+                                   move_to(move_stack_[ply]), pc, to);
     if (ply >= 2 && move_stack_[ply - 1] != MOVE_NONE)
-      score += *shared_->cont2.slot(piece_stack_[ply - 1],
-                                    move_to(move_stack_[ply - 1]), pc, to);
+      score += shared_->cont2.read(piece_stack_[ply - 1],
+                                   move_to(move_stack_[ply - 1]), pc, to);
   }
   return score;
 }
@@ -716,24 +718,48 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
   // the qsearch value, reverse futility returns the beta bound).
   int margin_eval = 0;
   bool have_margin = false;
-  if (!in_check && !is_pv && ply > 0 && depth <= 8) {
-    // depth <= 8 covers every margin pruning below (RFP 8, futility 3,
-    // razor 2); deeper nodes skip the piece loop entirely.
+  if (!in_check) {
+    // Computed at EVERY quiet node (hce_evaluate is a sub-microsecond
+    // deterministic piece loop): the margin prunings below gate on
+    // margin_ok, and the eval stack feeds `improving` at any depth.
     constexpr int LIMIT = VALUE_MATE_IN_MAX - 1;
     int v = hce_evaluate(pos);
     margin_eval = v < -LIMIT ? -LIMIT : (v > LIMIT ? LIMIT : v);
     have_margin = true;
+    if (ply <= MAX_PLY) eval_stack_[ply] = margin_eval;
   }
+  if (ply <= MAX_PLY) eval_valid_[ply] = !in_check;
+  // Improving: our static eval rose vs two plies ago (fall back to four
+  // when ply-2 was a check); three-state because the heuristics want
+  // OPPOSITE defaults when no ancestor exists. In-check nodes never
+  // count as improving.
+  int improving_state = -1;  // -1 unknown, 0 no, 1 yes
+  if (!in_check) {
+    if (ply >= 2 && eval_valid_[ply - 2])
+      improving_state = margin_eval > eval_stack_[ply - 2] ? 1 : 0;
+    else if (ply >= 4 && eval_valid_[ply - 4])
+      improving_state = margin_eval > eval_stack_[ply - 4] ? 1 : 0;
+  }
+  // LMP keeps more moves / LMR reduces less when improving — unknown
+  // defaults to the permissive side (treat as improving).
+  const bool improving = in_check ? false : improving_state != 0;
+  // RFP/futility margins SHRINK when improving (more pruning) — unknown
+  // defaults to the wide margin (treat as not improving), so an
+  // ancestor-less node never prunes harder than the pre-improving code.
+  const bool improving_margin = improving_state == 1;
+  // The margin prunings (RFP / razor / futility) keep their historical
+  // gates: non-PV, non-root, shallow.
+  const bool margin_ok = have_margin && !is_pv && ply > 0 && depth <= 8;
 
   // Reverse futility (static beta) pruning: far enough above beta that a
   // shallow search will not drop back under it.
-  if (have_margin && std::abs(beta) < VALUE_MATE_IN_MAX &&
-      margin_eval - 80 * depth >= beta)
+  if (margin_ok && std::abs(beta) < VALUE_MATE_IN_MAX &&
+      margin_eval - (improving_margin ? 60 : 80) * depth >= beta)
     return beta;
 
   // Razoring: hopeless at shallow depth — verify with qsearch and trust
   // a confirming fail-low.
-  if (have_margin && depth <= 3 && margin_eval + 280 * depth < alpha) {
+  if (margin_ok && depth <= 3 && margin_eval + 280 * depth < alpha) {
     int v = qsearch(pos, alpha - 1, alpha, ply);
     if (stopped_) return 0;
     if (v < alpha) return v;
@@ -751,8 +777,11 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
     path_.push_back(copy.hash);
     move_stack_[ply + 1] = MOVE_NONE;
     // Depth-scaled reduction (the flat R=2 this replaces wasted most of
-    // the null search's verification budget at high depth).
+    // the null search's verification budget at high depth), deepened
+    // further the more the static eval already clears beta.
     int R = 3 + depth / 4;
+    if (have_margin && margin_eval > beta)
+      R += std::min((margin_eval - beta) / 200, 3);
     int v = -alpha_beta(copy, -beta, -beta + 1, depth - 1 - R, ply + 1, false);
     path_.pop_back();
     if (stopped_) return 0;
@@ -925,17 +954,21 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
     if (!is_pv && !in_check && is_quiet && best > -VALUE_INF &&
         std::abs(alpha) < VALUE_MATE_IN_MAX && !copy.in_check()) {
       // Late move pruning: quiets this deep in the ordered list at
-      // shallow depth almost never raise alpha.
-      if (depth <= 4 && move_count > 4 + depth * depth) continue;
+      // shallow depth almost never raise alpha. The standard quadratic
+      // move-count bound, halved when the eval is not improving.
+      if (depth <= 8 &&
+          move_count > (3 + depth * depth) / (improving ? 1 : 2))
+        continue;
       // Futility: margin eval so far below alpha that a quiet move
       // cannot recover within the remaining depth.
-      if (depth <= 3 && have_margin && margin_eval + 120 * depth + 100 <= alpha)
+      if (depth <= 6 && margin_ok &&
+          margin_eval + 120 * (depth - (improving_margin ? 1 : 0)) + 100 <= alpha)
         continue;
       // Continuation-history pruning: a quiet whose combined history
       // signal is THIS bad at shallow depth is virtually never the
       // move that raises alpha (and when it would be, the re-visit at
       // depth+1 — where the bound no longer binds — still finds it).
-      if (depth <= 4 && !eager && scores[mi] < (1 << 15) &&
+      if (depth <= 6 && !eager && scores[mi] < (1 << 15) &&
           scores[mi] < -3000 * depth)
         continue;
     }
@@ -957,6 +990,15 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
       // reduction by up to one ply each way; replies that give check
       // reduce one less (exactly the quiets a reduced search misjudges).
       int reduction = 0;
+      if (depth >= 2 && move_count > 1 && !in_check && !is_quiet) {
+        // Late captures reduce too, one ply gentler than quiets: a
+        // capture deep in the ordered list is usually a bad exchange
+        // already demoted by SEE, not a tactic.
+        reduction = std::max(
+            0, kLmr.r[std::min(depth, 63)][std::min(move_count, 63)] - 1);
+        if (is_pv) reduction = std::max(0, reduction - 1);
+        reduction = std::max(0, std::min(reduction, depth - 2));
+      }
       if (depth >= 2 && move_count > 1 && is_quiet && !in_check) {
         reduction = kLmr.r[std::min(depth, 63)][std::min(move_count, 63)];
         if (is_pv) reduction--;
@@ -969,6 +1011,9 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
         if (h > 8192) reduction--;
         else if (h < -4096) reduction++;
         if (copy.in_check()) reduction--;
+        // A non-improving node's late quiets are the least likely
+        // moves on the board to matter: reduce one more (standard).
+        if (!improving) reduction++;
         reduction = std::max(0, std::min(reduction, depth - 2));
       }
       value = -alpha_beta(copy, -alpha - 1, -alpha, depth - 1 - reduction,
@@ -1059,14 +1104,25 @@ SearchResult Search::run(const Position& root,
 
   int max_depth = limits.depth > 0 ? std::min(limits.depth, MAX_PLY - 1) : MAX_PLY - 1;
   int multipv = std::min<int>(std::max(1, limits.multipv), root_moves.size);
+  // Weakened play needs candidates to blunder INTO: search at least 4
+  // root lines (Stockfish's own skill implementation does the same).
+  const bool weakened = limits.skill < 20;
+  int search_multipv =
+      weakened ? std::min<int>(std::max(multipv, 4), root_moves.size)
+               : multipv;
 
   Move overall_best = MOVE_NONE;
   int prev_value = 0;
   bool have_prev = false;
+  // (move, INTERNAL value) per rank of the last fully-completed
+  // iteration — the weakened pick needs comparable cp values, not the
+  // UCI-converted mate distances stored in result.lines.
+  std::vector<std::pair<Move, int>> iter_ranks, final_ranks;
 
   for (int depth = 1; depth <= max_depth && !stopped_; depth++) {
     std::vector<Move> excluded;
-    for (int rank = 1; rank <= multipv; rank++) {
+    bool all_ranks = true;
+    for (int rank = 1; rank <= search_multipv; rank++) {
       excluded_root_moves_ = excluded;
       // Aspiration window around the previous iteration's score (rank 1
       // only — secondary PVs have no stable anchor). A window miss
@@ -1093,11 +1149,16 @@ SearchResult Search::run(const Position& root,
           break;
         }
       }
-      if (stopped_ || pv_len_[0] == 0) break;  // discard interrupted search
+      if (stopped_ || pv_len_[0] == 0) {  // discard interrupted search
+        all_ranks = false;
+        break;
+      }
       if (rank == 1) {
         prev_value = value;
         have_prev = true;
+        iter_ranks.clear();
       }
+      iter_ranks.emplace_back(Move(pv_table_[0][0]), value);
       PvLine line;
       line.multipv = rank;
       line.depth = depth;
@@ -1113,12 +1174,49 @@ SearchResult Search::run(const Position& root,
     // At least one full iteration is in the bag; the node budget may now
     // interrupt freely.
     allow_stop_ = true;
+    if (all_ranks) final_ranks = iter_ranks;
     if (abort_now_ && *abort_now_) break;
     if (node_limit_ && nodes_ >= node_limit_) break;
     if (external_stop_ && *external_stop_) break;
   }
 
   result.best_move = overall_best;
+  if (weakened && final_ranks.size() > 1) {
+    // Stockfish-style skill pick: each candidate gets a pseudo-random
+    // "push" that grows with the level's weakness and with how close the
+    // line is to the best one; the highest pushed score plays. Seeded
+    // from (root hash, node count) so identical searches stay
+    // reproducible while successive moves of a game vary.
+    uint64_t s = root.hash ^ (nodes_ * 0x9E3779B97F4A7C15ull);
+    auto rng = [&s]() {
+      s += 0x9E3779B97F4A7C15ull;
+      uint64_t z = s;
+      z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+      z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+      return z ^ (z >> 31);
+    };
+    const int top = final_ranks.front().second;
+    const int bottom = final_ranks.back().second;
+    const int delta = std::min(top - bottom, 150);  // ~one pawn of spread
+    const int weakness = 120 - 2 * limits.skill;    // −9..19 → 138..82
+    // Normalizing by max(128, weakness) keeps the candidate's own score
+    // coefficient non-negative for the sub-zero skills the protocol
+    // allows (weakness > 128 would otherwise actively PREFER the worst
+    // line): at skill ≤ −4 the pick degrades to uniform noise among the
+    // candidates — a beginner playing any of 4 plausible moves — never
+    // an anti-engine.
+    const int norm = std::max(128, weakness);
+    int max_score = -VALUE_INF;
+    for (const auto& cand : final_ranks) {
+      const int push =
+          (weakness * (top - cand.second) +
+           delta * int(rng() % uint64_t(weakness))) / norm;
+      if (cand.second + push >= max_score) {
+        max_score = cand.second + push;
+        result.best_move = cand.first;
+      }
+    }
+  }
   result.nodes = nodes_;
   return result;
 }

@@ -14,6 +14,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <string>
 #include <vector>
 
@@ -186,7 +187,10 @@ class TranspositionTable {
   // 16 bits: new_generation() bumps once per Search::run, and a pool
   // serving hundreds of searches/s would wrap 8 bits in seconds,
   // aliasing ancient entries as fresh in the replacement ranking.
-  std::vector<uint16_t> gens_;
+  // atomic<uint16_t> with relaxed ops (same codegen as the plain word on
+  // x86/ARM) so the cross-thread accesses are defined behavior instead
+  // of a formal data race TSan would flag.
+  std::unique_ptr<std::atomic<uint16_t>[]> gens_;
   size_t mask_;  // cluster-index mask
   std::atomic<uint16_t> gen_{0};
 };
@@ -204,17 +208,32 @@ class TranspositionTable {
 // benign race every SMP engine accepts for its history tables).
 struct ContinuationHistory {
   static constexpr int PIECES = 12;
-  int16_t table[PIECES][64][PIECES][64];
-  ContinuationHistory() { std::memset(table, 0, sizeof(table)); }
-  int16_t* slot(int prev_pc, Square prev_to, int pc, Square to) {
+  // Relaxed atomics, not plain int16_t: scheduler threads race on these
+  // by design (a lost heuristic increment merely reorders a move), but
+  // the race must still be DEFINED behavior — plain words are formal UB
+  // the compiler may miscompile and TSan rightly flags. Relaxed
+  // load/store compiles to the identical mov on x86/ARM.
+  std::atomic<int16_t> table[PIECES][64][PIECES][64];
+  ContinuationHistory() {
+    // Runs before any sharing (pool construction), so the byte-wise
+    // zero of the trivially-copyable atomics is safe and instant.
+    std::memset(static_cast<void*>(table), 0, sizeof(table));
+  }
+  std::atomic<int16_t>* slot(int prev_pc, Square prev_to, int pc, Square to) {
     return &table[prev_pc][prev_to][pc][to];
   }
+  int read(int prev_pc, Square prev_to, int pc, Square to) {
+    return table[prev_pc][prev_to][pc][to].load(std::memory_order_relaxed);
+  }
   // Standard history gravity: saturates toward +-LIMIT, recent signals
-  // outweigh stale ones, no periodic aging pass needed.
-  static void bump(int16_t* h, int bonus) {
+  // outweigh stale ones, no periodic aging pass needed. The
+  // read-modify-write is deliberately NOT a CAS loop — losing a racing
+  // increment is cheaper than the contention of winning it.
+  static void bump(std::atomic<int16_t>* h, int bonus) {
     constexpr int LIMIT = 1 << 14;
-    int v = *h + bonus - int(*h) * std::abs(bonus) / LIMIT;
-    *h = int16_t(v);
+    int old = h->load(std::memory_order_relaxed);
+    int v = old + bonus - old * std::abs(bonus) / LIMIT;
+    h->store(int16_t(v), std::memory_order_relaxed);
   }
 };
 
@@ -249,6 +268,13 @@ struct SearchLimits {
   uint64_t nodes = 0;  // 0 = unlimited
   int depth = 0;       // 0 = unlimited (MAX_PLY)
   int multipv = 1;
+  // Engine skill −9..20; below 20 the search plays WEAKENED: candidate
+  // root lines are searched MultiPV-style and the reported best_move is
+  // sampled among them with a level-scaled value tolerance, so low
+  // levels genuinely blunder (the reference forwards the identical
+  // range to Stockfish's `Skill Level`, api.rs:222-273 /
+  // stockfish.rs:254-261; this is that mechanism, natively).
+  int skill = 20;
   // External stop request (e.g. movetime watchdog, service shutdown);
   // polled per node, may be set from any thread. The first depth-1
   // iteration still completes.
@@ -357,6 +383,12 @@ class Search {
   // (MOVE_NONE when none): the move loop skips it, and neither TT
   // cutoffs nor TT stores apply at a node searched with an exclusion.
   Move excluded_[MAX_PLY + 1];
+  // Static (HCE) eval per ply along the current path, for the
+  // `improving` signal: a node whose eval rose since two plies ago
+  // prunes less and reduces less. Valid only where eval_valid_ (not in
+  // check); indices < root ply are never read.
+  int eval_stack_[MAX_PLY + 1];
+  bool eval_valid_[MAX_PLY + 1];
   Move pv_table_[MAX_PLY][MAX_PLY];
   int pv_len_[MAX_PLY];
   std::vector<Move> excluded_root_moves_;  // for MultiPV iteration

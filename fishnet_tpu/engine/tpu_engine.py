@@ -17,8 +17,29 @@ from typing import Optional
 
 from fishnet_tpu.engine.base import Engine, EngineFactory, EngineError
 from fishnet_tpu.ipc import Position, PositionResponse
-from fishnet_tpu.protocol.types import EngineFlavor, Matrix, Score
+from fishnet_tpu.protocol.types import Clock, EngineFlavor, Matrix, Score
 from fishnet_tpu.search.service import SearchResultData, SearchService
+
+
+def clock_movetime_seconds(clock: Clock, white_to_move: bool) -> float:
+    """Clock-derived think-time bound for a play job. The reference
+    forwards wtime/btime/winc/binc and the engine's time manager takes
+    the minimum of that allocation and the level movetime
+    (src/stockfish.rs:307-336 + the engine's own timeman); this is that
+    allocation: a 1/40th share of the remaining clock plus most of the
+    increment, never more than half the remaining time, floor 10 ms so
+    a flagged clock still produces SOME move."""
+    mytime_ms = clock.wtime_ms if white_to_move else clock.btime_ms
+    alloc_ms = mytime_ms / 40.0 + 0.75 * clock.inc_ms
+    alloc_ms = min(alloc_ms, mytime_ms / 2.0)
+    return max(alloc_ms, 10.0) / 1000.0
+
+
+def _white_to_move(root_fen: str, moves: list) -> bool:
+    """Side to move after `moves` are applied to `root_fen`."""
+    parts = root_fen.split()
+    root_white = len(parts) < 2 or parts[1] != "b"
+    return root_white == (len(moves) % 2 == 0)
 
 
 def result_to_response(position: Position, result: SearchResultData) -> PositionResponse:
@@ -60,12 +81,27 @@ class TpuNnueEngine(Engine):
             depth = work.depth or 0
             multipv = work.effective_multipv()
             movetime = None
+            skill = 20
         else:
+            # Play job: the reference sends `go movetime <level> depth
+            # <level> wtime/btime/winc/binc` with `Skill Level` set
+            # (src/stockfish.rs:254-261, 286-336) — here that maps to a
+            # depth cap + the tighter of level movetime and the
+            # clock-derived allocation, plus native skill weakening.
             level = work.level
             nodes = 0
             depth = level.depth()
             multipv = 1
             movetime = level.movetime_ms() / 1000.0
+            skill = level.skill_level()
+            if work.clock is not None:
+                movetime = min(
+                    movetime,
+                    clock_movetime_seconds(
+                        work.clock,
+                        _white_to_move(position.root_fen, position.moves),
+                    ),
+                )
 
         try:
             result = await self.service.search(
@@ -76,6 +112,7 @@ class TpuNnueEngine(Engine):
                 multipv=multipv,
                 movetime_seconds=movetime,
                 variant=position.variant,
+                skill_level=skill,
             )
         except EngineError:
             raise

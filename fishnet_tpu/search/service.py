@@ -77,7 +77,7 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib.fc_pool_submit.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
     ]
     lib.fc_pool_submit.restype = ctypes.c_int
     lib.fc_pool_stop.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -391,11 +391,15 @@ class SearchService:
         movetime_seconds: Optional[float] = None,
         variant: Variant = Variant.STANDARD,
         stop_event: Optional[threading.Event] = None,
+        skill_level: int = 20,
     ) -> SearchResultData:
         """...with ``stop_event``: setting it (then ``poke()``) stops the
         native search gracefully — the call still returns the partial
         result (completed iterations), unlike cancellation, which
-        discards the search."""
+        discards the search. ``skill_level`` −9..20: below 20 the native
+        search samples its best move among near-best candidate lines so
+        play jobs genuinely weaken (api.rs:222-273 parity); analysis
+        callers leave the default full strength."""
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         token = object()
@@ -409,7 +413,7 @@ class SearchService:
             self._rr += 1
             self._submissions[t].append(
                 (root_fen, " ".join(moves), nodes, depth, multipv, future, loop,
-                 movetime_seconds, variant, token, stop_event)
+                 movetime_seconds, variant, token, stop_event, skill_level)
             )
         self._wakes[t].set()
         try:
@@ -742,7 +746,7 @@ class SearchService:
                 self._submissions[t] = []
             for item in submissions:
                 (fen, moves, nodes, depth, multipv, future, loop, movetime,
-                 variant, token, stop_event) = item
+                 variant, token, stop_event, skill) = item
                 if token in cancelled:
                     continue
                 use_scalar = 1 if self.backend == "scalar" else 0
@@ -750,7 +754,7 @@ class SearchService:
                 for g in groups:
                     slot = lib.fc_pool_submit(
                         self._pool, g, fen.encode(), moves.encode(),
-                        nodes, depth, multipv, use_scalar,
+                        nodes, depth, multipv, skill, use_scalar,
                         _VARIANT_CODES[variant],
                     )
                     if slot != -1:

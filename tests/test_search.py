@@ -142,13 +142,13 @@ def test_netless_pool_refuses_standard_search():
         start = b"rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
         for use_scalar in (0, 1):
             rc = lib.fc_pool_submit(
-                pool, -1, start, b"", 1000, 2, 1, use_scalar,
+                pool, -1, start, b"", 1000, 2, 1, 20, use_scalar,
                 _VARIANT_CODES[Variant.STANDARD],
             )
             assert rc == -5
         # Variant searches evaluate with the HCE and stay serviceable.
         rc = lib.fc_pool_submit(
-            pool, -1, start, b"", 1000, 1, 1, 0,
+            pool, -1, start, b"", 1000, 1, 1, 20, 0,
             _VARIANT_CODES[Variant.ANTICHESS],
         )
         assert rc >= 0
