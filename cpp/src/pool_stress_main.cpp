@@ -1,0 +1,190 @@
+// Multithreaded pool stress driver for the sanitizer gates (TSAN/ASAN).
+//
+// The Python determinism suite (tests/test_multithread.py) can prove
+// results don't change across thread counts, but it cannot SEE a data
+// race that happens to produce the same move. This driver exercises the
+// cross-thread surfaces of the pool — the lockless XOR-validated TT and
+// its generation side-array, the shared continuation-history tables,
+// the relaxed-atomic counters, and the per-slot stop/abort latches —
+// under instrumented builds (`make tsan` / `make asan`), where the
+// sanitizer runtime, not luck, decides whether the concurrency is
+// sound. Build and gate in CI (.github/workflows/build.yml sanitizers
+// job).
+//
+// Usage: pool-stress [net.nnue] [searches-per-thread] [threads]
+//   With a net file, half the traffic is standard-chess scalar-NNUE
+//   searches; the rest are variant/HCE searches. Both evaluate on the
+//   host and never suspend, so the whole search runs inside
+//   fc_pool_step — maximum concurrent TT/history pressure, no device.
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "types.h"
+
+// The pool's C surface (defined in pool.cpp; no public header by design
+// — Python binds via ctypes, and this driver links the objects).
+extern "C" {
+struct SearchPool;
+SearchPool* fc_pool_new(int slots, uint64_t tt_bytes, const char* net_path,
+                        int n_groups);
+void fc_pool_free(SearchPool* pool);
+int fc_pool_submit(SearchPool* pool, int group, const char* fen,
+                   const char* moves, uint64_t nodes, int depth, int multipv,
+                   int skill, int use_scalar, int variant);
+void fc_pool_stop_all(SearchPool* pool);
+int fc_pool_step(SearchPool* pool, int group, uint16_t* packed,
+                 int32_t* offsets, int32_t* buckets, int32_t* slots,
+                 int32_t* parent, int32_t* material, int capacity, int align,
+                 int32_t* rows);
+int fc_pool_active(SearchPool* pool, int group);
+int fc_pool_next_finished(SearchPool* pool, int group);
+int fc_pool_result_summary(SearchPool* pool, int slot, uint64_t* nodes,
+                           int32_t* depth, char* best, int best_len,
+                           int32_t* n_lines);
+void fc_pool_release(SearchPool* pool, int slot);
+int fc_pool_counters(SearchPool* pool, uint64_t* out, int n);
+}
+
+namespace {
+
+constexpr int CAPACITY = 256;
+
+struct Job {
+  const char* fen;
+  int variant;  // fc::VariantRules value
+  int use_scalar;
+};
+
+const char* STARTPOS = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1";
+const char* MIDGAME =
+    "r2q1rk1/ppp2ppp/2npbn2/2b1p3/4P3/2PP1NN1/PPB2PPP/R1BQ1RK1 w - - 6 9";
+const char* ENDGAME = "8/5pk1/6p1/8/3K4/8/5PP1/8 w - - 0 1";
+const char* HORDE_START =
+    "rnbqkbnr/pppppppp/8/1PP2PP1/PPPPPPPP/PPPPPPPP/PPPPPPPP/PPPPPPPP w kq - 0 1";
+const char* RK_START = "8/8/8/8/8/8/krbnNBRK/qrbnNBRQ w - - 0 1";
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* net_path = argc > 1 ? argv[1] : "";
+  const int per_thread = argc > 2 ? std::atoi(argv[2]) : 48;
+  const int n_threads = argc > 3 ? std::atoi(argv[3]) : 4;
+  const bool have_net = net_path[0] != '\0';
+
+  // Small TT on purpose: eviction (the racier path — victim ranking,
+  // generation reads, XOR re-stores) must fire constantly.
+  SearchPool* pool = fc_pool_new(/*slots=*/n_threads * 16,
+                                 /*tt_bytes=*/1 << 20, net_path, n_threads);
+  if (!pool) {
+    std::fprintf(stderr, "pool-stress: fc_pool_new failed\n");
+    return 1;
+  }
+
+  std::vector<Job> jobs;
+  if (have_net) {
+    jobs.push_back({STARTPOS, fc::VR_STANDARD, 1});
+    jobs.push_back({MIDGAME, fc::VR_STANDARD, 1});
+    jobs.push_back({ENDGAME, fc::VR_STANDARD, 1});
+  }
+  jobs.push_back({STARTPOS, fc::VR_ANTICHESS, 0});
+  jobs.push_back({MIDGAME, fc::VR_ATOMIC, 0});
+  jobs.push_back({STARTPOS, fc::VR_KING_OF_THE_HILL, 0});
+  jobs.push_back({MIDGAME, fc::VR_THREE_CHECK, 0});
+  jobs.push_back({HORDE_START, fc::VR_HORDE, 0});
+  jobs.push_back({RK_START, fc::VR_RACING_KINGS, 0});
+
+  std::atomic<uint64_t> done{0}, total_nodes{0};
+  std::atomic<bool> failed{false}, running{true};
+
+  auto drive = [&](int group) {
+    // Per-thread step buffers (owner-thread only, like the service's).
+    std::vector<uint16_t> packed((4 * CAPACITY + 4) * 2 * 8);
+    std::vector<int32_t> offsets(CAPACITY), buckets(CAPACITY),
+        slots(CAPACITY), parent(CAPACITY), material(CAPACITY);
+    int submitted = 0, harvested = 0;
+    while (harvested < per_thread && !failed.load()) {
+      while (submitted < per_thread) {
+        const Job& j = jobs[(group * 7 + submitted) % jobs.size()];
+        // Low skill on some searches: the weakened multipv pick also
+        // runs under the sanitizer.
+        int skill = (submitted % 5 == 0) ? -9 : 20;
+        int rc = fc_pool_submit(pool, group, j.fen, "", /*nodes=*/20000,
+                                /*depth=*/8, /*multipv=*/1, skill,
+                                j.use_scalar, j.variant);
+        if (rc == -1) break;  // group momentarily full
+        if (rc < 0) {
+          std::fprintf(stderr, "pool-stress: submit failed (%d)\n", rc);
+          failed = true;
+          return;
+        }
+        submitted++;
+      }
+      int32_t rows = 0;
+      int n = fc_pool_step(pool, group, packed.data(), offsets.data(),
+                           buckets.data(), slots.data(), parent.data(),
+                           material.data(), CAPACITY, 0, &rows);
+      if (n != 0) {
+        // Scalar/HCE searches never suspend for the device; eval
+        // requests here mean a job was misrouted to the batched bridge.
+        std::fprintf(stderr, "pool-stress: unexpected eval batch (%d)\n", n);
+        failed = true;
+        return;
+      }
+      int slot;
+      while ((slot = fc_pool_next_finished(pool, group)) >= 0) {
+        uint64_t nodes = 0;
+        int32_t depth = 0, n_lines = 0;
+        char best[8] = {0};
+        fc_pool_result_summary(pool, slot, &nodes, &depth, best,
+                               sizeof(best), &n_lines);
+        total_nodes.fetch_add(nodes, std::memory_order_relaxed);
+        fc_pool_release(pool, slot);
+        harvested++;
+        done.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+  };
+
+  // Telemetry thread: hammers the cross-thread read surfaces while the
+  // drivers mutate them.
+  std::thread telemetry([&] {
+    uint64_t counters[16];
+    while (running.load(std::memory_order_relaxed)) {
+      fc_pool_counters(pool, counters, 16);
+      for (int g = 0; g < n_threads; g++) fc_pool_active(pool, g);
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+
+  // Chaos thread: periodic stop_all exercises the any-thread stop
+  // latches against searches mid-node. Searches still return results
+  // (first-iteration guarantee), so the harvest loop completes.
+  std::thread chaos([&] {
+    while (running.load(std::memory_order_relaxed)) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(150));
+      fc_pool_stop_all(pool);
+    }
+  });
+
+  std::vector<std::thread> drivers;
+  for (int t = 0; t < n_threads; t++) drivers.emplace_back(drive, t);
+  for (auto& th : drivers) th.join();
+  running = false;
+  telemetry.join();
+  chaos.join();
+  fc_pool_free(pool);
+
+  if (failed.load()) return 1;
+  std::printf("pool-stress: %llu searches, %llu nodes, %d threads%s\n",
+              (unsigned long long)done.load(),
+              (unsigned long long)total_nodes.load(), n_threads,
+              have_net ? " (nnue+hce)" : " (hce only)");
+  return 0;
+}
