@@ -490,8 +490,25 @@ void fc_pool_stop(SearchPool* pool, int slot_id) {
 // driver is blocked inside fc_pool_step: each search polls its
 // stop_requested flag per node, so a long-running scalar search unwinds
 // promptly. Used by service shutdown.
+// Mass stops/aborts invalidate the speculation-ROI window: the drain
+// ships prefetches for fibers that are about to die and can never
+// consume them, so the next verdict would judge the POLICY on teardown
+// traffic and zero the budget for minutes into the following load
+// (measured: a post-drain window ran at budget 0 start to finish).
+// Restart the window at the current counters and forgive the verdict.
+static void reset_roi_window(SearchPool* pool) {
+  std::lock_guard<std::mutex> lk(pool->roi_mu);
+  pool->roi_last_shipped =
+      pool->counters.prefetch_shipped.load(std::memory_order_relaxed);
+  pool->roi_last_hits =
+      pool->counters.prefetch_hits.load(std::memory_order_relaxed);
+  pool->roi_check_step = pool->steps.load(std::memory_order_relaxed);
+  pool->roi_ok = true;
+}
+
 void fc_pool_stop_all(SearchPool* pool) {
   for (auto& slot : pool->slots) slot->stop_requested = true;
+  reset_roi_window(pool);
 }
 
 // Hard-abort every active search: unwind at the next node without the
@@ -501,6 +518,7 @@ void fc_pool_stop_all(SearchPool* pool) {
 // minutes; this costs one step. Safe from any thread.
 void fc_pool_abort_all(SearchPool* pool) {
   for (auto& slot : pool->slots) slot->abort_requested = true;
+  reset_roi_window(pool);
 }
 
 // Run all runnable fibers until each is blocked on an eval or finished.
@@ -733,7 +751,12 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_packed,
       std::unique_lock<std::mutex> lk(pool->roi_mu, std::try_to_lock);
       if (lk.owns_lock() &&
           pool->prefetch_adaptive.load(std::memory_order_relaxed)) {
-        constexpr uint64_t ROI_WINDOW = 32, ROI_PROBE = 512;
+        // ROI_PROBE at 512 steps was ~4 minutes of wall clock at the
+        // tunnel's ~2 steps/s — a zeroed budget could not recover
+        // within a bench window. 128 keeps probe overhead negligible
+        // (2 slots per 128 steps) while bounding budget-0 stretches to
+        // ~1 minute.
+        constexpr uint64_t ROI_WINDOW = 32, ROI_PROBE = 128;
         constexpr uint64_t ROI_MIN_SAMPLE = 2048;
         uint64_t step_now = pool->steps.load(std::memory_order_relaxed);
         if (step_now - pool->roi_check_step >= ROI_WINDOW) {
