@@ -145,6 +145,50 @@ class ShardedEvaluator:
             )
         )
 
+        # PACKED WIRE over the mesh (VERDICT r4 item 4): the service
+        # repacks the pool's row stream into a fixed per-shard row tier
+        # (see SearchService._dispatch_eval), so the leading axis splits
+        # evenly and each shard expands ITS OWN rows locally inside the
+        # shard_map — the multi-chip path now ships ~32 bytes per delta
+        # entry like the single-device path, instead of the 128-byte
+        # dense expansion (plus host CPU for expand_packed_np) it paid
+        # before. Jitted per row-tier (3 shapes), like the single-device
+        # compile matrix.
+        from fishnet_tpu.nnue.jax_eval import evaluate_packed
+
+        def local_packed(params, packed, offsets, buckets, parent, material):
+            return evaluate_packed(params, packed, offsets, buckets, parent,
+                                   material)
+
+        self._packed_fn = jax.jit(
+            _shard_map(
+                local_packed, mesh=self.mesh,
+                in_specs=(repl, batch_axes, batch_axes, batch_axes,
+                          batch_axes, batch_axes),
+                out_specs=batch_axes,
+            )
+        )
+
+    #: SearchService probes this to keep the packed wire on (service-side
+    #: per-shard repack + on-device expansion) instead of falling back to
+    #: the dense host-side expansion.
+    supports_packed = True
+
+    def packed_eval(self, params, packed, offsets, buckets, parent, material):
+        """Evaluate an ALREADY per-shard-repacked row stream: ``packed``
+        [n_devices * tier, 2, 8] (each shard's rows padded to the same
+        tier, trailing 4 sentinel rows per shard), ``offsets`` [B] with
+        SHARD-LOCAL row values. ``params`` is ignored like __call__."""
+        import numpy as _np
+
+        batch = offsets.shape[0]
+        parent = self._local_parents(parent, batch)
+        if material is None:
+            material = _np.zeros((batch,), _np.int32)
+        return self._packed_fn(
+            self.params, packed, offsets, buckets, parent, material
+        )
+
     def _local_parents(self, parent, batch):
         """Rebase batch-relative anchor codes to shard-local indices.
         Valid because the pool's aligned emission keeps every delta and

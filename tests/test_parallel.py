@@ -190,3 +190,43 @@ async def test_client_e2e_on_sharded_path(anyio_backend):
                 assert part["nodes"] >= 1
     finally:
         service.close()
+
+
+async def test_sharded_packed_search_parity(anyio_backend):
+    """The sharded PACKED wire (service-side per-shard repack +
+    on-device expansion inside the shard_map) must reproduce the
+    single-device backend's search results exactly — scores, mate
+    flags, and best moves, position by position. Sequential submission
+    + pinned prefetch, like every cross-backend parity suite (the TT
+    evolution must be a deterministic function of the sequence)."""
+    from fishnet_tpu.search.service import SearchService
+    from tests.test_search import _parity_results, _random_fens
+
+    weights = NnueWeights.random(seed=23)
+    fens = _random_fens(10, seed=123)
+
+    single = await _parity_results("jax", weights, fens, depth=3, prefetch=4)
+
+    evaluator = ShardedEvaluator(
+        params_from_weights(weights), mesh=make_mesh(), batch_capacity=64
+    )
+    svc = SearchService(
+        weights=weights, pool_slots=16, batch_capacity=64,
+        tt_bytes=64 << 20, evaluator=evaluator,
+    )
+    svc.set_prefetch(4, adaptive=False)
+    try:
+        assert svc._sharded_packed, "mesh path fell back to dense wire"
+        sharded = []
+        for fen in fens:
+            r = await svc.search(fen, [], depth=3)
+            line = [l for l in r.lines if l.multipv == 1][-1]
+            sharded.append((line.value, line.is_mate, r.best_move))
+    finally:
+        svc.close()
+    mismatches = [
+        (fen, s, j) for fen, s, j in zip(fens, single, sharded) if s != j
+    ]
+    assert not mismatches, (
+        f"{len(mismatches)} of {len(fens)} diverged; first: {mismatches[0]}"
+    )
