@@ -157,6 +157,123 @@ async def test_defer_promote_stages_without_touching_root(tmp_path, monkeypatch)
         assert not status.staged.exists()  # staging consumed
 
 
+def _sign(data: bytes):
+    """Mint a keypair and sign `data`; returns (pubkey_hex, sig_hex)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    key = Ed25519PrivateKey.generate()
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    return pub.hex(), key.sign(data).hex()
+
+
+class SignedChannel(FakeChannel):
+    def __init__(self, signature=None, **kw):
+        super().__init__(**kw)
+        self.signature = signature
+
+    async def _index(self, request):
+        self.index_hits += 1
+        body = {
+            "latest": self.latest,
+            "artifact": "v99.0.0/fishnet-tpu.tar.gz",
+            "sha256": self.sha256,
+        }
+        if self.signature:
+            body["signature"] = self.signature
+        return web.json_response(body)
+
+
+async def test_default_channel_requires_signature(tmp_path, monkeypatch):
+    """Bucket compromise =/= RCE: an UNSIGNED index from the default
+    channel must never be installed, sha256 notwithstanding."""
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    async with SignedChannel() as ch:  # no signature field
+        monkeypatch.setattr(update_mod, "DEFAULT_CHANNEL", ch.base)
+        status = await update_mod.apply_update(
+            allow_default=True, install_root=tmp_path
+        )
+        assert status.checked and status.update_available
+        assert not status.updated
+        assert not (tmp_path / "fishnet_tpu").exists()
+
+
+async def test_default_channel_accepts_pinned_signature(tmp_path, monkeypatch):
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    tarball = make_release_tarball()
+    pub, sig = _sign(tarball)
+    async with SignedChannel(tarball=tarball, signature=sig) as ch:
+        monkeypatch.setattr(update_mod, "DEFAULT_CHANNEL", ch.base)
+        monkeypatch.setattr(update_mod, "SIGNING_PUBKEY_HEX", pub)
+        status = await update_mod.apply_update(
+            allow_default=True, install_root=tmp_path
+        )
+        assert status.updated
+        assert (tmp_path / "fishnet_tpu" / "_release_marker.py").exists()
+
+
+async def test_default_channel_rejects_wrong_signature(tmp_path, monkeypatch):
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    tarball = make_release_tarball()
+    pub, _ = _sign(tarball)
+    _, wrong_sig = _sign(b"some other artifact")
+    async with SignedChannel(tarball=tarball, signature=wrong_sig) as ch:
+        monkeypatch.setattr(update_mod, "DEFAULT_CHANNEL", ch.base)
+        monkeypatch.setattr(update_mod, "SIGNING_PUBKEY_HEX", pub)
+        status = await update_mod.apply_update(
+            allow_default=True, install_root=tmp_path
+        )
+        assert not status.updated
+        assert not (tmp_path / "fishnet_tpu").exists()
+
+
+async def test_default_channel_never_runs_index_command(tmp_path, monkeypatch):
+    """An index `command` from the DEFAULT channel is an RCE attempt,
+    not an update mechanism — refuse it outright."""
+    monkeypatch.delenv(update_mod.UPDATE_URL_ENV, raising=False)
+    marker = tmp_path / "pwned"
+
+    class CommandChannel(FakeChannel):
+        async def _index(self, request):
+            return web.json_response(
+                {"latest": "99.0.0", "command": ["touch", str(marker)]}
+            )
+
+    async with CommandChannel() as ch:
+        monkeypatch.setattr(update_mod, "DEFAULT_CHANNEL", ch.base)
+        status = await update_mod.apply_update(allow_default=True)
+        assert not status.updated
+        assert not marker.exists()
+
+
+async def test_operator_pinned_key_enforced_on_override(tmp_path, monkeypatch):
+    """FISHNET_TPU_UPDATE_PUBKEY on a private mirror: omitting the
+    signature must FAIL (no silent downgrade), a valid one installs."""
+    tarball = make_release_tarball()
+    pub, sig = _sign(tarball)
+    async with SignedChannel(tarball=tarball) as ch:  # unsigned index
+        monkeypatch.setenv(update_mod.UPDATE_URL_ENV, f"{ch.base}/index.json")
+        monkeypatch.setenv(update_mod.UPDATE_PUBKEY_ENV, pub)
+        status = await update_mod.apply_update(install_root=tmp_path)
+        assert not status.updated
+    async with SignedChannel(tarball=tarball, signature=sig) as ch:
+        monkeypatch.setenv(update_mod.UPDATE_URL_ENV, f"{ch.base}/index.json")
+        monkeypatch.setenv(update_mod.UPDATE_PUBKEY_ENV, pub)
+        status = await update_mod.apply_update(install_root=tmp_path)
+        assert status.updated
+
+
+def test_validate_member_sanitizes_modes():
+    info = tarfile.TarInfo("fishnet_tpu/x.py")
+    info.mode = 0o6777  # setuid+setgid+world-writable
+    update_mod._validate_member(info)
+    assert info.mode == 0o755
+
+
 async def test_defer_promote_defers_legacy_command(monkeypatch, tmp_path):
     """A command-index update must NOT run the command mid-flight when
     the caller asked for deferral (the live environment would be
