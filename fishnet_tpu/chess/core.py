@@ -86,10 +86,15 @@ def _candidate_libraries() -> list:
         "v2": ["v2"],
         "arm64": ["arm64"],
     }.get(tier, [])
+    # Tier libraries live in cpp/ (source checkout) or in the package's
+    # own _native/ (pip/pipx wheel install, where cpp/ doesn't exist) —
+    # setup.py's build hook copies `make tiers` output there.
+    native_dir = Path(__file__).resolve().parent.parent / "_native"
     for t in tiers:
-        path = _CPP_DIR / f"libfishnetcore-{t}.so"
-        if path.exists():
-            candidates.append(path)
+        for base in (_CPP_DIR, native_dir):
+            path = base / f"libfishnetcore-{t}.so"
+            if path.exists():
+                candidates.append(path)
     if not candidates:
         raise NativeCoreError(
             "no native core library found (build with `make -C cpp` or ship "

@@ -1,0 +1,69 @@
+"""Build hook for the one-command install: compile the portable CPU
+tiers of the native core into the wheel.
+
+`pip install .` / `pipx install .` runs build_py below, which invokes
+`make -C cpp tiers` (plus the host-native library when a toolchain
+exists) and copies the .so's into ``fishnet_tpu/_native/`` — the
+package-internal location the loader (fishnet_tpu/chess/core.py)
+searches after the source-checkout cpp/ directory. A box without a C++
+toolchain can still install from a WHEEL built elsewhere (CI's package
+job), which already contains the tiers; building from sdist without a
+compiler fails loudly here rather than at first run.
+"""
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = Path(__file__).resolve().parent
+CPP = ROOT / "cpp"
+NATIVE = ROOT / "fishnet_tpu" / "_native"
+
+
+class BuildWithNativeTiers(build_py):
+    def run(self):
+        self._build_tiers()
+        super().run()
+
+    def _build_tiers(self):
+        NATIVE.mkdir(exist_ok=True)
+        # Portable tiers only: the -march=native libfishnetcore.so is
+        # this build host's CPU and must never ship in a wheel (the
+        # loader picks among the v2/v3/v4/arm64 tiers by cpuid).
+        prebuilt = list(CPP.glob("libfishnetcore-*.so")) if CPP.exists() else []
+        # Preserve a PGO build: CI runs `make pgo && make tiers PGO=1`
+        # before the wheel step; re-running make with PGO unset would
+        # flip the .pgo-mode stamp and silently rebuild every tier
+        # WITHOUT the profile. Read the stamp and keep whatever mode the
+        # existing artifacts were built in.
+        stamp = CPP / ".pgo-mode"
+        make_cmd = ["make", "-C", str(CPP), "-j", "tiers"]
+        if stamp.exists() and "pgo=1" in stamp.read_text():
+            make_cmd.append("PGO=1")
+        try:
+            subprocess.run(
+                make_cmd, check=True, capture_output=True, text=True,
+            )
+            prebuilt = list(CPP.glob("libfishnetcore-*.so"))
+        except (subprocess.CalledProcessError, OSError) as err:
+            if not prebuilt:
+                stderr = getattr(err, "stderr", "") or str(err)
+                raise SystemExit(
+                    "fishnet-tpu: native core build failed and no prebuilt "
+                    f"tier libraries exist under cpp/ — install a C++ "
+                    f"toolchain (g++, make) or install from a built wheel.\n"
+                    f"{stderr[-2000:]}"
+                ) from err
+            print(
+                "fishnet-tpu: no toolchain; packaging prebuilt tier "
+                "libraries", file=sys.stderr,
+            )
+        for so in prebuilt:
+            shutil.copy2(so, NATIVE / so.name)
+
+
+setup(cmdclass={"build_py": BuildWithNativeTiers})
