@@ -44,6 +44,30 @@ constexpr int16_t EVAL_NONE = TT_EVAL_NONE;
 // never part of a returned score.
 constexpr int kPieceValue[PIECE_TYPE_NB] = {100, 320, 330, 500, 950, 0};
 
+// The piece type a capture removes (e.p. takes a pawn); callers pass
+// genuine captures only.
+inline int capture_victim(const Position& pos, Move m) {
+  return move_kind(m) == MK_EN_PASSANT ? PAWN
+                                       : piece_type(pos.piece_on(move_to(m)));
+}
+
+inline int capture_attacker(const Position& pos, Move m) {
+  return move_kind(m) == MK_DROP ? PAWN
+                                 : piece_type(pos.piece_on(move_from(m)));
+}
+
+// Shared losing-capture predicate for ordering demotion and the
+// prefetch prediction gates: SEE is only consulted when the exchange
+// CAN lose (attacker outvalues victim) — winning/equal captures stay
+// zero-cost. ``threshold``: the SEE value below which the consumer
+// skips the move (0 for demotion, -200*depth for the shallow prune).
+inline bool losing_capture(const Position& pos, Move m, int threshold) {
+  int victim = capture_victim(pos, m);
+  int attacker = capture_attacker(pos, m);
+  return kPieceValue[attacker] > kPieceValue[victim] + (-threshold) &&
+         see_applicable(pos.variant) && see(pos, m) < threshold;
+}
+
 size_t floor_pow2(size_t n) {
   size_t p = 1;
   while (p * 2 <= n) p *= 2;
@@ -386,23 +410,15 @@ void Search::score_moves(const Position& pos, const MoveList& moves,
     if (m == tt_move) {
       score = 1 << 30;
     } else if (!pos.empty(move_to(m)) || move_kind(m) == MK_EN_PASSANT) {
-      int victim = move_kind(m) == MK_EN_PASSANT
-                       ? PAWN
-                       : piece_type(pos.piece_on(move_to(m)));
-      int attacker = move_kind(m) == MK_DROP
-                         ? PAWN
-                         : piece_type(pos.piece_on(move_from(m)));
+      int victim = capture_victim(pos, m);
+      int attacker = capture_attacker(pos, m);
       score = (1 << 20) + victim * 16 - attacker;
       // Losing captures (SEE < 0) go behind every quiet: MVV-LVA alone
       // tries QxP-with-the-pawn-defended before killers, wasting the
-      // early slots the whole ordering scheme exists to protect. SEE is
-      // only consulted when the exchange CAN lose (attacker outvalues
-      // victim) — the common winning/equal captures stay zero-cost.
+      // early slots the whole ordering scheme exists to protect.
       // Gated on see_full_: demoting captures only pays when a losing
       // exchange implies a losing eval (see search.h ctor comment).
-      if (eager_see && see_full_ &&
-          kPieceValue[attacker] > kPieceValue[victim] &&
-          see_applicable(pos.variant) && see(pos, m) < 0)
+      if (eager_see && see_full_ && losing_capture(pos, m, 0))
         score = -(1 << 20) + victim * 16 - attacker;
     } else if (move_promo(m) == QUEEN) {
       score = 1 << 19;
@@ -455,6 +471,41 @@ void Search::update_quiet_stats(const Position& pos, Move best, int depth,
   apply(best, bonus);
   for (int i = 0; i < n_tried; i++)
     if (tried[i] != best) apply(tried[i], -bonus);
+}
+
+// Prediction-gated speculation (VERDICT r4 item 1): speculative child
+// evals are only worth shipping when the search will actually CONSUME
+// them, and the consumption sites are all predictable host-side from
+// the sub-microsecond classical eval. Measured before gating: 85% of
+// shipped evals were speculative with ROI 0.18 — two thirds of all
+// device slots bought nothing. The predictions mirror the exact
+// pruning conditions of the consuming loops (qsearch delta/SEE
+// pruning, depth-1 LMP/futility); a wrong prediction costs one extra
+// demand round-trip, never correctness. Only meaningful when the net
+// tracks material (see_full_ — the same probe that gates the pruning
+// heuristics themselves).
+int Search::filter_qsearch_prefetch(const Position& pos,
+                                    const MoveList& targets, MoveList& keep,
+                                    int pred, int alpha, int beta) const {
+  // Predicted stand-pat cutoff: the most common qsearch outcome. The
+  // capture loop never runs, so every child eval would be waste.
+  if (pred - 250 >= beta && std::abs(beta) < VALUE_MATE_IN_MAX) return 0;
+  for (Move m : targets) {
+    if (move_promo(m) == NO_PIECE_TYPE) {
+      // Child predicted delta-pruned (loop: best + victim + 200 <=
+      // alpha, best ~= stand ~= pred +- HCE/NNUE skew; 300 cp of slack
+      // keeps the prediction conservative).
+      int victim = capture_victim(pos, m);
+      if (victim >= 0 && victim < PIECE_TYPE_NB &&
+          std::abs(alpha) < VALUE_MATE_IN_MAX &&
+          pred + kPieceValue[victim] + 500 <= alpha)
+        continue;
+      // Losing captures are skipped outright by the qsearch SEE prune.
+      if (losing_capture(pos, m, 0)) continue;
+    }
+    keep.push(m);
+  }
+  return keep.size;
 }
 
 int Search::prefetch_evals(const Position& pos, const MoveList& children,
@@ -593,8 +644,21 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
     } else {
       build_targets();
       if (eval_->batched()) {
-        stand = prefetch_evals(pos, targets, /*include_self=*/true,
-                               eval_->prefetch_budget());
+        if (see_full_) {
+          // Gate the speculative children on the classical eval's
+          // prediction of what the loop below will consume (see
+          // filter_qsearch_prefetch). Self always ships — it IS the
+          // demand eval.
+          MoveList keep;
+          int n = filter_qsearch_prefetch(pos, targets, keep,
+                                          hce_evaluate(pos), alpha, beta);
+          stand = prefetch_evals(
+              pos, keep, /*include_self=*/true,
+              std::min(n, eval_->prefetch_budget()));
+        } else {
+          stand = prefetch_evals(pos, targets, /*include_self=*/true,
+                                 eval_->prefetch_budget());
+        }
       } else {
         stand = evaluate(pos);
         tt_->store_eval(pos.hash, stand);
@@ -612,9 +676,7 @@ int Search::qsearch(const Position& pos, int alpha, int beta, int ply) {
     if (!in_check && !forced_captures && best > -VALUE_MATE_IN_MAX &&
         std::abs(alpha) < VALUE_MATE_IN_MAX &&
         move_promo(m) == NO_PIECE_TYPE) {
-      int victim = move_kind(m) == MK_EN_PASSANT
-                       ? PAWN
-                       : piece_type(pos.piece_on(move_to(m)));
+      int victim = capture_victim(pos, m);
       if (victim >= 0 && victim < PIECE_TYPE_NB &&
           best + kPieceValue[victim] + 200 <= alpha)
         continue;
@@ -871,10 +933,44 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
     // Frontier prefetch: at depth 1 each visited child becomes a
     // qsearch root needing a stand-pat eval — fetch them (ordered,
     // within the pool's speculation budget) in one round-trip instead
-    // of one each.
-    if (eval_->batched())
-      prefetch_evals(pos, moves, /*include_self=*/false,
-                     eval_->prefetch_budget());
+    // of one each. PREDICTION-GATED: the move loop's own LMP/futility/
+    // SEE conditions are exact functions of state already in hand, so
+    // children the loop will prune are never shipped (they were the
+    // bulk of the measured speculative waste; a futility-exempt
+    // check-giving quiet is the one mispredicted class — it costs a
+    // demand round-trip, not correctness).
+    if (eval_->batched()) {
+      if (see_full_ && !is_pv && !in_check) {
+        const bool fut_all =
+            margin_ok &&
+            margin_eval + 120 * (depth - (improving_margin ? 1 : 0)) + 100 <=
+                alpha &&
+            std::abs(alpha) < VALUE_MATE_IN_MAX;
+        const int lmp_bound = (3 + depth * depth) / (improving ? 1 : 2);
+        MoveList pf;
+        int mc = 0;
+        for (Move m : moves) {
+          mc++;
+          bool quiet = pos.empty(move_to(m)) &&
+                       move_kind(m) != MK_EN_PASSANT &&
+                       move_promo(m) == NO_PIECE_TYPE;
+          // The first move is always searched (pruning waits for a
+          // banked score); after it, mirror the loop's quiet pruning.
+          if (mc > 1 && quiet && (fut_all || mc > lmp_bound)) continue;
+          // Mirror the loop's shallow SEE prune exactly (-200*depth, not
+          // 0): a mildly losing capture IS searched and needs its eval.
+          if (mc > 1 && !quiet && move_promo(m) == NO_PIECE_TYPE &&
+              losing_capture(pos, m, -200 * depth))
+            continue;
+          pf.push(m);
+        }
+        prefetch_evals(pos, pf, /*include_self=*/false,
+                       std::min(int(pf.size), eval_->prefetch_budget()));
+      } else {
+        prefetch_evals(pos, moves, /*include_self=*/false,
+                       eval_->prefetch_budget());
+      }
+    }
   } else {
     score_moves(pos, moves, tt_move, ply, scores);
   }
@@ -898,17 +994,11 @@ int Search::alpha_beta(const Position& pos, int alpha, int beta, int depth,
       // un-demoted capture — not on band arithmetic, which a pawn
       // victim (value 0) slips under.
       if (see_full_ && !see_checked[bi] && m != tt_move && bs > 0 &&
-          (!pos.empty(move_to(m)) || move_kind(m) == MK_EN_PASSANT) &&
-          see_applicable(pos.variant)) {
+          (!pos.empty(move_to(m)) || move_kind(m) == MK_EN_PASSANT)) {
         see_checked[bi] = true;
-        int victim = move_kind(m) == MK_EN_PASSANT
-                         ? PAWN
-                         : piece_type(pos.piece_on(move_to(m)));
-        int attacker = move_kind(m) == MK_DROP
-                           ? PAWN
-                           : piece_type(pos.piece_on(move_from(m)));
-        if (kPieceValue[attacker] > kPieceValue[victim] && see(pos, m) < 0) {
-          scores[bi] = -(1 << 20) + victim * 16 - attacker;
+        if (losing_capture(pos, m, 0)) {
+          scores[bi] = -(1 << 20) + capture_victim(pos, m) * 16 -
+                       capture_attacker(pos, m);
           continue;
         }
       }

@@ -338,6 +338,13 @@ class Search {
   // eval steals batch capacity from another fiber.
   int prefetch_evals(const Position& pos, const MoveList& children,
                      bool include_self, int max_children);
+  // Prediction gate for the qsearch stand-pat-miss prefetch: keep only
+  // the targets the capture loop is predicted to consume (`pred` = the
+  // classical eval standing in for the unknown NNUE stand-pat). Returns
+  // the kept count; 0 predicts a stand-pat cutoff (ship self only).
+  int filter_qsearch_prefetch(const Position& pos, const MoveList& targets,
+                              MoveList& keep, int pred, int alpha,
+                              int beta) const;
   bool is_repetition_or_50(const Position& pos, int ply) const;
   void order_moves(const Position& pos, MoveList& moves, Move tt_move, int ply);
   // Score moves into ``scores`` — the single banding source for every
