@@ -39,7 +39,7 @@ extern "C" {
 // change; the Python binder refuses mismatched libraries (a stale
 // prebuilt tier .so with an old layout would otherwise corrupt memory
 // through shifted arguments).
-int fc_abi_version() { return 6; }
+int fc_abi_version() { return 7; }
 
 int fc_init() {
   init_bitboards();

@@ -61,8 +61,9 @@ struct Slot;
 // Block requests (prefetched siblings/children) ride one suspension.
 class BatchedEval : public EvalBridge {
  public:
-  BatchedEval(Slot* slot, const NnueNet* net, const std::atomic<int>* budget)
-      : slot_(slot), net_(net), budget_(budget) {}
+  BatchedEval(Slot* slot, const NnueNet* net, const std::atomic<int>* budget,
+              const bool* anchors)
+      : slot_(slot), net_(net), budget_(budget), anchors_(anchors) {}
   int evaluate(const Position& pos) override;
   void evaluate_block(const Position* positions, int n, int32_t* out) override;
   bool batched() const override { return true; }
@@ -75,6 +76,9 @@ class BatchedEval : public EvalBridge {
   Slot* slot_;
   const NnueNet* net_;  // PSQT table for the host-side material term
   const std::atomic<int>* budget_;
+  // Pool-level persistent-anchor switch (set once by the service before
+  // traffic; read-only afterwards).
+  const bool* anchors_;
 };
 
 struct Slot {
@@ -117,11 +121,28 @@ struct Slot {
   // Bucket-selected material term per entry, ready for the wire.
   int32_t material[EVAL_BLOCK_MAX];
   // Incremental-eval reference, block-relative: -1 = standalone full
-  // feature set; else (ref_entry << 1) | persp_swap, meaning this
-  // entry's features are DELTAS against that (always-full) entry's
+  // feature set; >= 0 is (ref_entry << 1) | persp_swap, meaning this
+  // entry's features are DELTAS against that (anchor) entry's
   // accumulator, with the two perspectives swapped when the sides to
-  // move differ. Rebased to batch-relative indices at emission.
+  // move differ (rebased to batch-relative indices at emission);
+  // -2/-3 (PERSISTENT / PERSISTENT_SWAP) mark entry 0 as a delta
+  // against the slot's DEVICE-RESIDENT anchor accumulator — the
+  // accumulator this slot's previous block stored on the device
+  // (emit_block maps these to the wire's table-row codes).
   int32_t parent_code[EVAL_BLOCK_MAX];
+  // Device-resident anchor bookkeeping (VERDICT r4 item 1): the
+  // position + host-side PSQT accumulators of the accumulator currently
+  // stored in this slot's anchor-table row on the device. `pending_*`
+  // snapshots entry 0 of the block built most recently — it becomes the
+  // slot's anchor when (and only when) that block is actually emitted
+  // (a block can wait several steps for batch capacity, and an aliased
+  // single never ships at all).
+  bool anchor_valid = false;
+  bool pending_anchor_valid = false;
+  Position anchor_pos;
+  Position pending_pos;
+  int32_t anchor_psqt[2][NNUE_PSQT_BUCKETS];
+  int32_t pending_psqt[2][NNUE_PSQT_BUCKETS];
   int32_t eval_values[EVAL_BLOCK_MAX];
   // Position hash per entry: the key for in-step deduplication.
   uint64_t entry_hash[EVAL_BLOCK_MAX];
@@ -153,6 +174,11 @@ void fill_full(Slot* slot, const NnueNet* net, int j, const Position& pos) {
   slot->parent_code[j] = -1;
 }
 
+// Slot-level parent codes (mapped to the wire encoding at emission).
+constexpr int32_t PARENT_FULL = -1;
+constexpr int32_t PARENT_PERSISTENT = -2;       // delta vs device anchor row
+constexpr int32_t PARENT_PERSISTENT_SWAP = -3;  // ... with perspectives swapped
+
 // Incremental feature extraction: entry j's accumulator = ref's
 // accumulator (perspectives swapped if the side to move differs) plus
 // the added-piece rows minus the removed-piece rows. Wire contract
@@ -171,8 +197,14 @@ void fill_full(Slot* slot, const NnueNet* net, int j, const Position& pos) {
 // — a ~4x cut in row DMAs for the prefetch-block children that
 // dominate batch traffic (one move touches at most 2 adds / 3 removes:
 // mover or promotion to-piece, plus from-square, victim, e.p. pawn).
+// ``ref_psqt`` points at the reference accumulators ([2][8], reference
+// perspective order): the anchor entry's in-block psqt, or the slot's
+// device-anchor copy. ``ref_entry`` >= 0 encodes an in-block reference;
+// -1 encodes a delta against the slot's DEVICE-RESIDENT anchor
+// (PARENT_PERSISTENT codes).
 bool fill_delta(Slot* slot, const NnueNet* net, int j, const Position& ref,
-                const Position& pos, int ref_entry) {
+                const Position& pos,
+                const int32_t (*ref_psqt)[NNUE_PSQT_BUCKETS], int ref_entry) {
   constexpr int DELTA_SLOTS = NNUE_DELTA_SLOTS;
   bool swap = pos.stm != ref.stm;
   for (int p = 0; p < 2; p++) {
@@ -206,7 +238,7 @@ bool fill_delta(Slot* slot, const NnueNet* net, int j, const Position& ref,
     // PSQT: parent's accumulator for the SAME COLOR (parent perspective
     // p^swap), plus the delta rows. Kings match (checked above), so the
     // child's feature indexing agrees with the parent's for this color.
-    const int32_t* ref_ps = slot->psqt[ref_entry][swap ? p ^ 1 : p];
+    const int32_t* ref_ps = ref_psqt[swap ? p ^ 1 : p];
     int32_t* ps = slot->psqt[j][p];
     for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) ps[b] = ref_ps[b];
     for (int i = 0; i < n_add; i++) {
@@ -218,7 +250,9 @@ bool fill_delta(Slot* slot, const NnueNet* net, int j, const Position& ref,
       for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) ps[b] -= prow[b];
     }
   }
-  slot->parent_code[j] = (ref_entry << 1) | (swap ? 1 : 0);
+  slot->parent_code[j] =
+      ref_entry >= 0 ? ((ref_entry << 1) | (swap ? 1 : 0))
+                     : (swap ? PARENT_PERSISTENT_SWAP : PARENT_PERSISTENT);
   return true;
 }
 
@@ -231,23 +265,39 @@ void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out)
     int chunk = std::min(n - base, EVAL_BLOCK_MAX);
     // ANCHOR PROTOCOL (the fused TPU kernel depends on it,
     // ops/ft_gather.py): every delta entry references the MOST RECENT
-    // full entry preceding it — so the kernel reconstructs children
+    // anchor entry preceding it — so the kernel reconstructs children
     // from a single running anchor accumulator held in VMEM instead of
-    // a batch-wide gather. Entry 0 is always full; a failed delta
-    // (king moved, too many diffs) becomes full and the new anchor.
-    int last_full = 0;
+    // a batch-wide gather. Entry 0 is always an anchor: full, or (with
+    // persistent anchors enabled) a one-row delta against the
+    // accumulator this slot's PREVIOUS block stored device-side —
+    // single demand evals then ship 32 bytes instead of 128. A failed
+    // delta (king moved, too many diffs) becomes full and the new
+    // in-block anchor.
+    int last_anchor = 0;
     for (int j = 0; j < chunk; j++) {
       const Position& pos = positions[base + j];
-      if (j == 0 || !fill_delta(slot_, net_, j, positions[base + last_full],
-                                pos, last_full)) {
+      if (j == 0) {
+        if (!(*anchors_ && slot_->anchor_valid &&
+              fill_delta(slot_, net_, 0, slot_->anchor_pos, pos,
+                         slot_->anchor_psqt, /*ref_entry=*/-1)))
+          fill_full(slot_, net_, 0, pos);
+      } else if (!fill_delta(slot_, net_, j, positions[base + last_anchor],
+                             pos, slot_->psqt[last_anchor], last_anchor)) {
         fill_full(slot_, net_, j, pos);
-        last_full = j;
+        last_anchor = j;
       }
       slot_->buckets[j] = nnue_psqt_bucket(pos);
       slot_->material[j] =
           (slot_->psqt[j][0][slot_->buckets[j]] -
            slot_->psqt[j][1][slot_->buckets[j]]) / 2;
       slot_->entry_hash[j] = pos.hash;
+    }
+    if (*anchors_) {
+      // Entry 0 becomes the slot's device anchor once this block ships
+      // (emit_block finalizes; see the Slot field comment).
+      slot_->pending_anchor_valid = true;
+      slot_->pending_pos = positions[base];
+      memcpy(slot_->pending_psqt, slot_->psqt[0], sizeof(slot_->pending_psqt));
     }
     slot_->block_n = chunk;
     slot_->wants_eval = true;
@@ -281,6 +331,11 @@ struct SearchPool {
   std::atomic<uint64_t> step_capacity{0};  // sum of capacities (occupancy denom)
   std::atomic<uint64_t> delta_evals{0};    // eval slots shipped as deltas
   std::atomic<uint64_t> dedup_evals{0};    // requests served as aliases
+  std::atomic<uint64_t> anchor_evals{0};   // deltas vs device-resident anchors
+  // Persistent-anchor switch: set ONCE by the service (before traffic)
+  // when its evaluator understands the anchor-table wire codes; plain
+  // bool because it is read-only while fibers run.
+  bool anchors_enabled = false;
   // Adaptive speculation budget (max speculative evals per prefetch
   // block). Halved whenever a step overflows capacity — wasted slots
   // then displace other fibers' demand evals — and grown back while
@@ -459,10 +514,28 @@ int fc_pool_submit(SearchPool* pool, int group, const char* fen,
     slot.active = false;
     return -4;
   }
+  // A fresh search must not diff against a previous occupant's anchor.
+  slot.anchor_valid = false;
+  slot.pending_anchor_valid = false;
   if (!slot.bridge)
     slot.bridge = std::make_unique<BatchedEval>(
-        &slot, pool->scalar_net.get(), &pool->prefetch_budget);
+        &slot, pool->scalar_net.get(), &pool->prefetch_budget,
+        &pool->anchors_enabled);
   return id;
+}
+
+// Enable persistent device-resident anchors: entry 0 of every eval
+// block may ship as a one-row delta against the accumulator the slot's
+// previous block stored in its anchor-table row (wire parent codes
+// <= -2; see emit_block). Only call when the evaluator implements the
+// anchor table (jax_eval.evaluate_packed_anchored) and BEFORE any
+// submissions. With anchors on, every step's batch must be provided IN
+// FULL (fc_pool_provide n == the step's return): a partial provide
+// re-emits a block whose entry-0 delta references an anchor row the
+// first emission already overwrote. The one caller (search service)
+// always provides in full.
+void fc_pool_set_anchors(SearchPool* pool, int enable) {
+  pool->anchors_enabled = enable != 0;
 }
 
 // Pin (adaptive=0) or re-seed (adaptive=1) the speculation budget.
@@ -590,7 +663,9 @@ EmitResult emit_block(SearchPool* pool,
     int idx = base + j;
     int32_t code = slot.parent_code[j];
     out_offsets[idx] = row_cursor;
-    if (code >= 0) {
+    // Persistent-delta entries (code <= PARENT_PERSISTENT) ship one
+    // row exactly like in-block deltas.
+    if (code >= 0 || code <= PARENT_PERSISTENT) {
       // Delta entry: one packed row carries its 2*NNUE_DELTA_SLOTS live
       // slots per perspective (= ROW with the spec's DELTA_SLOTS of 4).
       for (int p = 0; p < 2; p++)
@@ -607,17 +682,42 @@ EmitResult emit_block(SearchPool* pool,
     out_buckets[idx] = slot.buckets[j];
     out_slots[idx] = i;
     out_material[idx] = slot.material[j];
-    // Rebase delta references from block entries to batch positions
-    // (the whole block ships in this batch, so the reference resolves
-    // within the same device call). Blocks are emitted contiguously, so
-    // the anchor protocol's "most recent preceding full entry"
-    // invariant carries over to batch indices unchanged.
-    out_parent[idx] =
-        code < 0 ? -1 : int32_t(((base + (code >> 1)) << 1) | (code & 1));
-    if (code >= 0)
+    // WIRE parent encoding: -1 plain full; >= 0 in-batch delta
+    // (ref << 1 | swap, rebased from block entries to batch positions —
+    // the whole block ships in this batch, so the reference resolves
+    // within the same device call; blocks are emitted contiguously, so
+    // the anchor protocol's "most recent preceding anchor entry"
+    // invariant carries over to batch indices unchanged); <= -2 anchor-
+    // entry codes: -(2 + v) with v = (table_row << 2) | (is_delta << 1)
+    // | swap — the entry resolves against (is_delta) or refreshes
+    // (always) the slot's device-resident anchor-table row.
+    if (code >= 0) {
+      out_parent[idx] = int32_t(((base + (code >> 1)) << 1) | (code & 1));
       pool->delta_evals.fetch_add(1, std::memory_order_relaxed);
+    } else if (j == 0 && slot.pending_anchor_valid) {
+      int32_t aid = i / pool->n_groups;  // slot's row in its group's table
+      int32_t v;
+      if (code <= PARENT_PERSISTENT) {
+        v = (aid << 2) | 2 | (code == PARENT_PERSISTENT_SWAP ? 1 : 0);
+        pool->delta_evals.fetch_add(1, std::memory_order_relaxed);
+        pool->anchor_evals.fetch_add(1, std::memory_order_relaxed);
+      } else {
+        v = aid << 2;  // full entry that (re)seeds the anchor row
+      }
+      out_parent[idx] = -(2 + v);
+    } else {
+      out_parent[idx] = -1;
+    }
     seen.emplace(slot.entry_hash[j], idx);  // dedup target for later singles
     batch.emplace_back(i, j);
+  }
+  // The block is on the wire: entry 0's accumulator is (about to be)
+  // the slot's device-side anchor.
+  if (slot.pending_anchor_valid) {
+    slot.anchor_pos = slot.pending_pos;
+    memcpy(slot.anchor_psqt, slot.pending_psqt, sizeof(slot.anchor_psqt));
+    slot.anchor_valid = true;
+    slot.pending_anchor_valid = false;
   }
   return EMIT_OK;
 }
@@ -828,9 +928,11 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_packed,
 // [11] search nodes visited, LIVE (bumped per node, not at finish) —
 //      lets telemetry compute steady-state nps over a time window
 //      without waiting for searches to complete
+// [12] eval slots shipped as deltas against DEVICE-RESIDENT anchors
+//      (subset of [9]; the persistent-anchor coverage metric)
 int fc_pool_counters(SearchPool* pool, uint64_t* out, int n) {
   constexpr auto R = std::memory_order_relaxed;
-  const uint64_t vals[12] = {
+  const uint64_t vals[13] = {
       pool->steps.load(R),          pool->evals_shipped.load(R),
       pool->suspensions.load(R),    pool->step_capacity.load(R),
       pool->counters.demand_evals.load(R),
@@ -841,8 +943,9 @@ int fc_pool_counters(SearchPool* pool, uint64_t* out, int n) {
       pool->delta_evals.load(R),
       pool->dedup_evals.load(R),
       pool->counters.nodes.load(R),
+      pool->anchor_evals.load(R),
   };
-  int k = n < 12 ? n : 12;
+  int k = n < 13 ? n : 13;
   for (int i = 0; i < k; i++) out[i] = vals[i];
   return k;
 }

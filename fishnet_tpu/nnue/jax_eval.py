@@ -113,7 +113,20 @@ def evaluate_batch(
             delta_base=spec.DELTA_BASE,
             parent=parent,
         )
+    return _evaluate_from_acc(params, acc, indices, buckets, parent, material)
 
+
+def _evaluate_from_acc(
+    params: Params,
+    acc: jax.Array,
+    indices: jax.Array,
+    buckets: jax.Array,
+    parent: Optional[jax.Array],
+    material: Optional[jax.Array],
+) -> jax.Array:
+    """The network head past the feature transformer: clipped pairwise
+    multiply, bucketed dense stack, PSQT/material blend (see
+    evaluate_batch for semantics)."""
     if material is None:
         if parent is None:
             psqt_rows = jnp.take(params["ft_psqt"], indices, axis=0)
@@ -229,13 +242,23 @@ def expand_packed(
     rows = jnp.clip(rows, 0, packed.shape[0] - 1)
     g = jnp.take(packed, rows, axis=0)  # [B, 4, 2, 8]
     dense = jnp.transpose(g, (0, 2, 1, 3)).reshape(-1, 2, 4 * 8)  # [B, 2, 32]
-    # Delta entries: row 0 holds the live slots, the rest is sentinel.
+    # Delta entries (in-batch or persistent): row 0 holds the live
+    # slots, the rest is sentinel.
     sent = jnp.full(
         (dense.shape[0], 2, 3 * 8), spec.NUM_FEATURES, jnp.int32
     )
     delta_dense = jnp.concatenate([dense[:, :, :8], sent], axis=2)
-    is_delta = (parent.astype(jnp.int32) >= 0)[:, None, None]
-    return jnp.where(is_delta, delta_dense, dense)
+    return jnp.where(_is_delta(parent)[:, None, None], delta_dense, dense)
+
+
+def _is_delta(parent: jax.Array) -> jax.Array:
+    """True for one-row (delta) entries under the wire's parent codes:
+    in-batch refs (>= 0) and persistent anchor deltas (<= -2 with the
+    delta bit); plain fulls (-1) and full anchor (re)seeds own 4 rows."""
+    from fishnet_tpu.ops.ft_gather import decode_parent
+
+    in_batch, persistent, _, _, _, _ = decode_parent(parent)
+    return in_batch | persistent
 
 
 def evaluate_packed(
@@ -252,6 +275,73 @@ def evaluate_packed(
 
 
 evaluate_packed_jit = jax.jit(evaluate_packed)
+
+
+def evaluate_packed_anchored(
+    params: Params,
+    packed: jax.Array,
+    buckets: jax.Array,
+    parent: jax.Array,
+    material: jax.Array,
+    anchor_tab: jax.Array,
+    n_rows: jax.Array,
+):
+    """evaluate_batch over the compact wire with PERSISTENT device-
+    resident anchors (VERDICT r4 item 1): ``anchor_tab`` [A, 2, L1]
+    int32 holds one feature-transformer accumulator per pool slot of
+    the dispatching group; persistent parent codes resolve against it,
+    and every anchor entry's resolved accumulator is scattered back to
+    its row. Returns ``(values, new_anchor_tab)`` — the caller threads
+    the table into the next step's call, so it lives on the device
+    across steps and single demand evals ship one 32-byte row instead
+    of a 128-byte full entry.
+
+    Two wire arrays are GONE relative to evaluate_packed: row offsets
+    (derivable — entries own 4 rows when full, 1 when delta, so offsets
+    are the exclusive cumsum) and the explicit store list (anchor codes
+    carry their own table row). ``n_rows`` (int32 [1], the emitted row
+    count) is what replaces the offsets array on the wire: padding
+    entries' cumsum continues past the stream into STALE buffer rows
+    whose contents can exceed the weight-table bounds (out-of-bounds
+    DMAs in the fused kernel), so every offset clamps to ``n_rows``,
+    where the service writes one sentinel block.
+    """
+    assert material is not None, "the native pool always ships material"
+    from fishnet_tpu.ops.ft_gather import decode_parent, ft_accumulate
+
+    parent = parent.astype(jnp.int32)
+    rows_per = jnp.where(_is_delta(parent), 1, 4)
+    offsets = jnp.cumsum(rows_per) - rows_per  # exclusive prefix sum
+    offsets = jnp.minimum(offsets, n_rows.astype(jnp.int32)[0])
+    dense = expand_packed(packed, offsets, parent)
+    acc = ft_accumulate(
+        params["ft_w"],
+        params["ft_b"],
+        dense,
+        delta_base=spec.DELTA_BASE,
+        parent=parent,
+        anchor_tab=anchor_tab,
+    )
+    values = _evaluate_from_acc(params, acc, dense, buckets, parent, material)
+    # Store anchor entries' resolved accumulators back to their rows.
+    # Rows are unique within a batch (one block per pool slot per step),
+    # so the scatter has no conflicts; non-anchor entries aim past the
+    # table and drop.
+    _, _, stores, _, _, aid = decode_parent(parent)
+    row = jnp.where(stores, aid, anchor_tab.shape[0])
+    new_tab = anchor_tab.at[row].set(
+        acc.reshape(parent.shape[0], 2, -1), mode="drop"
+    )
+    return values, new_tab
+
+
+#: The anchor table is DONATED: the scatter updates it in place instead
+#: of copying the whole table every step (callers must rebind their
+#: handle to the returned table — the input buffer is dead after the
+#: call).
+evaluate_packed_anchored_jit = jax.jit(
+    evaluate_packed_anchored, donate_argnums=(5,)
+)
 
 
 def expand_packed_np(packed, offsets, parent):

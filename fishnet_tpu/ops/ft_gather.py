@@ -62,25 +62,52 @@ def _xla_ft_accumulate(
     return ft_b.astype(jnp.int32) + jnp.sum(rows, axis=2)
 
 
-def _xla_resolve_parents(
-    acc: jax.Array, ft_b: jax.Array, parent: jax.Array
-) -> jax.Array:
-    """Resolve incremental entries of an XLA-partials accumulator batch:
-    parent int32 [B], -1 full, else (ref << 1) | swap with ref a batch
-    index of a FULL entry. Exact: integer adds commute, so delta partial
-    + referenced accumulator - (the doubly counted) bias is bit-identical
-    to a full gather."""
-    parent = parent.astype(jnp.int32)
-    valid = parent >= 0
-    ref = jnp.where(valid, parent >> 1, 0)
-    swap = (parent & 1).astype(bool)
+def _swap_persp(a: jax.Array, swap: jax.Array) -> jax.Array:
+    """Swap the perspective axis (axis 1 of [B, 2, ...]) where ``swap``."""
     perm = jnp.where(swap[:, None], jnp.array([1, 0]), jnp.array([0, 1]))
-    ref_acc = jnp.take_along_axis(
-        jnp.take(acc, ref, axis=0), perm[:, :, None], axis=1
-    )
-    return jnp.where(
-        valid[:, None, None], acc + ref_acc - ft_b.astype(jnp.int32), acc
-    )
+    return jnp.take_along_axis(a, perm[:, :, None], axis=1)
+
+
+def decode_parent(parent: jax.Array):
+    """Split the wire's parent codes (cpp/src/pool.cpp emit_block) into
+    masks: -1 plain full; >= 0 in-batch delta (ref << 1 | swap); <= -2
+    anchor-entry codes -(2 + v), v = (table_row << 2) | (is_delta << 1)
+    | swap — the entry resolves against (is_delta) and/or refreshes
+    (always) its device anchor-table row. Returns (in_batch, persistent,
+    stores, ref, swap, aid)."""
+    parent = parent.astype(jnp.int32)
+    v = -parent - 2
+    stores = parent <= -2
+    persistent = stores & ((v & 2) != 0)
+    in_batch = parent >= 0
+    ref = jnp.where(in_batch, parent >> 1, 0)
+    swap = jnp.where(in_batch, parent & 1, v & 1).astype(bool)
+    aid = jnp.where(stores, v >> 2, 0)
+    return in_batch, persistent, stores, ref, swap, aid
+
+
+def _xla_resolve_parents(
+    acc: jax.Array,
+    ft_b: jax.Array,
+    parent: jax.Array,
+    anchor_tab: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Resolve incremental entries of an XLA-partials accumulator batch
+    (see decode_parent for the codes). Two passes: persistent deltas
+    resolve against their anchor-table rows first (anchor entries are
+    never in-batch deltas, so their resolution is final), then in-batch
+    deltas gather their — now resolved — anchor entries. Exact: integer
+    adds commute, so delta partial + referenced accumulator - (the
+    doubly counted) bias is bit-identical to a full gather."""
+    in_batch, persistent, _, ref, swap, aid = decode_parent(parent)
+    bias = ft_b.astype(jnp.int32)
+    if anchor_tab is not None:
+        tab_acc = _swap_persp(
+            jnp.take(anchor_tab.astype(jnp.int32), aid, axis=0), swap
+        )
+        acc = jnp.where(persistent[:, None, None], acc + tab_acc - bias, acc)
+    ref_acc = _swap_persp(jnp.take(acc, ref, axis=0), swap)
+    return jnp.where(in_batch[:, None, None], acc + ref_acc - bias, acc)
 
 
 #: Slot budget of the SPARSE mode, per perspective: incremental (delta)
@@ -99,8 +126,9 @@ def _xla_resolve_parents(
 _SPARSE_SLOTS = 2 * _DELTA_SLOTS
 
 
-def _kernel(idx_ref, flags_ref, ft_ref, bias_ref, carry_ref, out_ref, rows,
-            sems, anchor, *, delta_base, anchored):
+def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
+            tab_ref, out_ref, rows, sems, anchor, pa, pa_sems, *,
+            delta_base, anchored):
     # Software-pipelined gather: scratch holds TWO positions' rows. Grid
     # step b waits on the buffer its predecessor filled for it, issues
     # position b+1's row DMAs into the other buffer, then reduces — so
@@ -113,8 +141,14 @@ def _kernel(idx_ref, flags_ref, ft_ref, bias_ref, carry_ref, out_ref, rows,
     # (incremental/delta) entry touching only _SPARSE_SLOTS slots per
     # perspective with removal slots decoded by subtracting delta_base;
     # bit 1 (anchored mode) = the entry's perspectives are swapped
-    # relative to its anchor. Dense entries fetch all slots as plain
-    # additions.
+    # relative to its anchor; bit 2 (anchored mode) = PERSISTENT — the
+    # anchor is not the running in-batch one but row aid_ref[b] of the
+    # HBM anchor table (the accumulator this entry's pool slot stored in
+    # a previous batch), DMA'd into the pa scratch alongside the delta
+    # rows (~8 KB vs the ~120 KB of a full gather). Dense entries fetch
+    # all slots as plain additions. Table WRITES happen outside the
+    # kernel (jax_eval scatters the output accumulators of anchor
+    # entries back into the table).
     b = pl.program_id(0)
     n = pl.num_programs(0)
     n_active = rows.shape[1] // 2  # both perspectives share a buffer
@@ -150,23 +184,40 @@ def _kernel(idx_ref, flags_ref, ft_ref, bias_ref, carry_ref, out_ref, rows,
         def _():
             fn(n_active, False)
 
+    def anchor_dma(pos, slot, start):
+        # One DMA for the whole [2, sub, 128] anchor row; issued/awaited
+        # only for persistent entries (scalar-prefetched flag, so the
+        # issuing step for b+1 and the waiting step at b+1 agree).
+        if not anchored:
+            return
+
+        @pl.when((flags_ref[pos] & 4) != 0)
+        def _():
+            dma = pltpu.make_async_copy(
+                tab_ref.at[aid_ref[pos]], pa.at[slot], pa_sems.at[slot]
+            )
+            dma.start() if start else dma.wait()
+
     slot = jax.lax.rem(b, 2)
 
     @pl.when(b == 0)
     def _():
         both_modes(0, lambda lim, sp: transfer(0, 0, True, lim, sp))
+        anchor_dma(0, 0, True)
         if anchored:
             # Chunk carry-in: the anchor as of the end of the previous
             # chunk (zeros for the first — the pool guarantees batch
-            # entry 0 is full, so it is never read there).
+            # entry 0 is an anchor entry, so it is never read there).
             anchor[...] = carry_ref[...]
 
     @pl.when(b + 1 < n)
     def _():
         nxt = jax.lax.rem(b + 1, 2)
         both_modes(b + 1, lambda lim, sp: transfer(b + 1, nxt, True, lim, sp))
+        anchor_dma(b + 1, nxt, True)
 
     both_modes(b, lambda lim, sp: transfer(b, slot, False, lim, sp))
+    anchor_dma(b, slot, False)
 
     bias = bias_ref[...].astype(jnp.int32)
 
@@ -199,19 +250,27 @@ def _kernel(idx_ref, flags_ref, ft_ref, bias_ref, carry_ref, out_ref, rows,
             for p in range(2):
                 out_ref[0, p] = bias + partial[p]
             return
-        # Resolve against the running anchor (the most recent full
-        # entry): bit 1 says whether the perspectives are swapped.
+        # Resolve against the running anchor (the most recent anchor
+        # entry), or — persistent entries — the anchor-table row DMA'd
+        # into pa. Bit 1 says whether the perspectives are swapped.
         swap = (flags_ref[b] & 2) != 0
+        persistent = (flags_ref[b] & 4) != 0
+        base = [
+            jnp.where(persistent, pa[slot, p], anchor[p]) for p in range(2)
+        ]
+        res = [
+            jnp.where(swap, base[1 - p], base[p]) + partial[p]
+            for p in range(2)
+        ]
+        for p in range(2):
+            out_ref[0, p] = res[p]
 
-        @pl.when(swap)
+        @pl.when(persistent)
         def _():
+            # A resolved persistent entry IS an anchor entry: later
+            # in-batch deltas of its block reference it.
             for p in range(2):
-                out_ref[0, p] = anchor[1 - p] + partial[p]
-
-        @pl.when(jnp.logical_not(swap))
-        def _():
-            for p in range(2):
-                out_ref[0, p] = anchor[p] + partial[p]
+                anchor[p] = res[p]
 
     if delta_base is None:
         reduce_full(n_active)
@@ -244,6 +303,8 @@ def _pallas_ft_accumulate(
     ft_b: jax.Array,
     indices: jax.Array,
     flags: Optional[jax.Array] = None,
+    anchor_ids: Optional[jax.Array] = None,
+    anchor_tab: Optional[jax.Array] = None,
     interpret: bool = False,
     delta_base: int | None = None,
     anchored: bool = False,
@@ -258,24 +319,34 @@ def _pallas_ft_accumulate(
     # slices are tile-aligned (Mosaic requires sublane multiples of 8).
     ft_tiles = ft_w.reshape(ft_w.shape[0], sub, 128)
     bias_tile = ft_b.reshape(sub, 128)
+    if anchor_tab is None:
+        # Dummy 1-row table: flag bit 2 is never set without a real
+        # table, so the kernel issues no anchor DMAs against it.
+        tab_tiles = jnp.zeros((1, 2, sub, 128), jnp.int32)
+    else:
+        tab_tiles = anchor_tab.astype(jnp.int32).reshape(-1, 2, sub, 128)
 
-    def run_chunk(idx_chunk, flags_chunk, carry):
+    def run_chunk(idx_chunk, flags_chunk, aid_chunk, carry):
         chunk = idx_chunk.shape[0]
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,  # indices + per-position flags
+            num_scalar_prefetch=3,  # indices + flags + anchor row ids
             grid=(chunk,),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.ANY),  # ft_w stays in HBM
                 pl.BlockSpec(memory_space=pltpu.VMEM),  # bias
                 pl.BlockSpec(memory_space=pltpu.VMEM),  # anchor carry-in
+                pl.BlockSpec(memory_space=pltpu.ANY),  # anchor table (HBM)
             ],
             out_specs=pl.BlockSpec(
-                (1, 2, sub, 128), lambda b, idx_ref, flags_ref: (b, 0, 0, 0)
+                (1, 2, sub, 128),
+                lambda b, idx_ref, flags_ref, aid_ref: (b, 0, 0, 0),
             ),
             scratch_shapes=[
                 pltpu.VMEM((2, 2 * n_active, sub, 128), ft_w.dtype),
                 pltpu.SemaphoreType.DMA((2, 2 * n_active)),
                 pltpu.VMEM((2, sub, 128), jnp.int32),  # running anchor
+                pltpu.VMEM((2, 2, sub, 128), jnp.int32),  # persistent rows
+                pltpu.SemaphoreType.DMA((2,)),
             ],
         )
         return pl.pallas_call(
@@ -284,30 +355,39 @@ def _pallas_ft_accumulate(
             out_shape=jax.ShapeDtypeStruct((chunk, 2, sub, 128), jnp.int32),
             grid_spec=grid_spec,
             interpret=interpret,
-        )(idx_chunk, flags_chunk, ft_tiles, bias_tile, carry)
+        )(idx_chunk, flags_chunk, aid_chunk, ft_tiles, bias_tile, carry,
+          tab_tiles)
 
     idx = indices.astype(jnp.int32)
     if flags is None:
         flags = jnp.zeros((batch,), jnp.int32)
     else:
         flags = flags.astype(jnp.int32)
+    if anchor_ids is None:
+        anchor_ids = jnp.zeros((batch,), jnp.int32)
+    else:
+        anchor_ids = anchor_ids.astype(jnp.int32)
     carry = jnp.zeros((2, sub, 128), jnp.int32)
     outs = []
     for start in range(0, batch, _CHUNK):
         idx_c = idx[start : start + _CHUNK]
         fl_c = flags[start : start + _CHUNK]
-        out = run_chunk(idx_c, fl_c, carry)
+        aid_c = anchor_ids[start : start + _CHUNK]
+        out = run_chunk(idx_c, fl_c, aid_c, carry)
         outs.append(out)
         if anchored and start + _CHUNK < batch:
-            # Next chunk's carry-in: the accumulator of the last FULL
-            # entry so far (an anchor referenced across a chunk edge is
-            # by protocol the most recent full entry of the batch).
-            is_full = (fl_c & 1) == 0
-            has_full = jnp.any(is_full)
-            last_full = (
-                idx_c.shape[0] - 1 - jnp.argmax(is_full[::-1]).astype(jnp.int32)
+            # Next chunk's carry-in: the accumulator of the last ANCHOR
+            # entry so far — full (bit 0 clear) or persistent-resolved
+            # (bit 2) — matching the in-kernel running-anchor rule.
+            is_anchor = ((fl_c & 1) == 0) | ((fl_c & 4) != 0)
+            has_anchor = jnp.any(is_anchor)
+            last_anchor = (
+                idx_c.shape[0] - 1
+                - jnp.argmax(is_anchor[::-1]).astype(jnp.int32)
             )
-            carry = jnp.where(has_full, jnp.take(out, last_full, axis=0), carry)
+            carry = jnp.where(
+                has_anchor, jnp.take(out, last_anchor, axis=0), carry
+            )
     out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
     return out.reshape(batch, persp, l1)
 
@@ -322,6 +402,7 @@ def ft_accumulate(
     delta_base: int | None = None,
     sparse: Optional[jax.Array] = None,
     parent: Optional[jax.Array] = None,
+    anchor_tab: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Feature-transformer accumulators, bias included: int32 [B, 2, L1].
 
@@ -335,12 +416,16 @@ def ft_accumulate(
 
     Two incremental modes:
 
-    * ``parent`` given (int32 [B]; -1 = full, else (ref << 1) | swap):
+    * ``parent`` given (int32 [B]; see decode_parent for the codes):
       delta entries are RESOLVED — the result is every entry's complete
       accumulator. The fused kernel resolves from a running in-VMEM
-      anchor, relying on the pool's guarantee that ref is always the
-      most recent preceding full entry; the XLA fallback gathers by the
-      explicit ref index. Bit-identical either way.
+      anchor, relying on the pool's guarantee that an in-batch ref is
+      always the most recent preceding anchor entry; the XLA fallback
+      gathers by the explicit ref index. Bit-identical either way.
+      With ``anchor_tab`` ([A, 2, L1] int32) given, PERSISTENT codes
+      (<= -2 with the delta bit) resolve against the table instead —
+      callers own storing anchor entries' accumulators back (the table
+      is read-only here).
     * ``sparse`` given (bool [B]) without ``parent``: delta entries come
       back as bias-included PARTIALS (adds - removes); the caller owns
       resolution. (Kept for tests and schema-level users.)
@@ -356,14 +441,21 @@ def ft_accumulate(
     if parent is not None:
         parent = parent.astype(jnp.int32)
         if use_pallas or interpret:
-            # bit 0: sparse; bit 1: perspective swap vs the anchor.
-            flags = jnp.where(parent >= 0, 1 | ((parent & 1) << 1), 0)
+            # bit 0: sparse; bit 1: perspective swap vs the anchor;
+            # bit 2: persistent (anchor-table row in anchor_ids).
+            in_batch, persistent, _, _, swap, aid = decode_parent(parent)
+            sparse_f = in_batch | persistent
+            flags = (
+                sparse_f.astype(jnp.int32)
+                | (swap.astype(jnp.int32) << 1)
+                | (persistent.astype(jnp.int32) << 2)
+            )
             return _pallas_ft_accumulate(
-                ft_w, ft_b, indices, flags,
+                ft_w, ft_b, indices, flags, aid, anchor_tab,
                 interpret=interpret, delta_base=delta_base, anchored=True,
             )
         acc = _xla_ft_accumulate(ft_w, ft_b, indices, delta_base=delta_base)
-        return _xla_resolve_parents(acc, ft_b, parent)
+        return _xla_resolve_parents(acc, ft_b, parent, anchor_tab)
     if use_pallas or interpret:
         flags = None if sparse is None else sparse.astype(jnp.int32)
         return _pallas_ft_accumulate(

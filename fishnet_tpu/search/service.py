@@ -120,6 +120,7 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib.fc_pool_set_prefetch.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
     ]
+    lib.fc_pool_set_anchors.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib._pool_bound = True
 
 
@@ -298,13 +299,13 @@ class SearchService:
                 import jax
 
                 from fishnet_tpu.nnue.jax_eval import (
-                    evaluate_packed_jit,
+                    evaluate_packed_anchored_jit,
                     params_from_weights,
                 )
 
                 w = weights if weights is not None else NnueWeights.load(net_path)
                 self._params = jax.device_put(params_from_weights(w))
-                self._eval_fn = evaluate_packed_jit
+                self._eval_fn = evaluate_packed_anchored_jit
 
         # Driver state. Buffers must exist before the thread starts.
         cap = batch_capacity
@@ -343,15 +344,37 @@ class SearchService:
             self._eval_sizes = sorted({min(s, cap) for s in sizes})
             self._shard_align = 0
         # COMPACT WIRE: the pool emits a packed uint16 row stream (full
-        # entry = 4 rows of [2][8], delta entry = 1 row) plus int32 row
-        # offsets — deltas ship 32 bytes instead of 128 (VERDICT r3
-        # item 4). The built-in evaluator expands on DEVICE
-        # (jax_eval.expand_packed); external evaluators (sharded mesh,
-        # test doubles) receive the dense expansion host-side.
+        # entry = 4 rows of [2][8], delta entry = 1 row) — deltas ship
+        # 32 bytes instead of 128 (VERDICT r3 item 4). The built-in
+        # evaluator expands on DEVICE (jax_eval.expand_packed) and
+        # derives row offsets there too (cumsum over parent codes), so
+        # only rows + buckets + parents + material ride the wire; the
+        # offsets buffer below feeds the sharded repack and the dense
+        # host expansion for external evaluators.
         # One buffer set per group: a group's buffers must stay
         # untouched while its dispatched eval is still in flight, and
         # each group is only ever touched by its owning thread.
         k = self._n_groups
+        # PERSISTENT DEVICE ANCHORS (VERDICT r4 item 1): one feature-
+        # transformer accumulator per pool slot lives ON DEVICE across
+        # steps ([rows, 2, L1] int32 per group, threaded through every
+        # anchored eval call), so a slot's next demand eval ships as a
+        # one-row delta instead of a 128-byte full entry. Per-group
+        # tables because each group's eval chain is serialized by its
+        # pipeline (the next call consumes the previous call's returned
+        # table) while different groups' calls overlap freely.
+        self._anchor_tabs = None
+        if backend == "jax" and evaluator is None:
+            import jax
+            import jax.numpy as jnp
+
+            rows_per_group = -(-pool_slots // self._n_groups)
+            self._anchor_tabs = [
+                jax.device_put(jnp.zeros((rows_per_group, 2, spec.L1),
+                                         jnp.int32))
+                for _ in range(self._n_groups)
+            ]
+            self._lib.fc_pool_set_anchors(self._pool, 1)
         # (_sharded_packed — the packed-capable mesh predicate — is set
         # once above, before the _eval_fn selection.) Sharded evaluators
         # that understand the packed wire get the service-side per-shard
@@ -516,13 +539,13 @@ class SearchService:
                         packed = np.full(
                             (tier, 2, 8), spec.NUM_FEATURES, np.uint16
                         )
-                        offsets = np.zeros((s,), np.int32)
-                        np.asarray(
-                            self._eval_fn(
-                                self._params, packed, offsets, bucks,
-                                parents, material,
-                            )
+                        # The table is DONATED: rebind the handle or the
+                        # next call would use a dead buffer.
+                        values, self._anchor_tabs[0] = self._eval_fn(
+                            self._params, packed, bucks, parents, material,
+                            self._anchor_tabs[0], np.zeros((1,), np.int32),
                         )
+                        np.asarray(values)
                     else:
                         feats = np.full(
                             (s, 2, spec.MAX_ACTIVE_FEATURES),
@@ -573,13 +596,13 @@ class SearchService:
         the measurements behind occupancy / prefetch-ROI / cache-rate
         (see cpp SearchCounters). Safe to read at any time; values are
         monotone and single-writer."""
-        buf = (ctypes.c_uint64 * 12)()
-        n = self._lib.fc_pool_counters(self._pool, buf, 12)
+        buf = (ctypes.c_uint64 * 13)()
+        n = self._lib.fc_pool_counters(self._pool, buf, 13)
         out = {k: int(buf[i]) for i, k in enumerate((
             "steps", "evals_shipped", "suspensions", "step_capacity",
             "demand_evals", "prefetch_shipped", "prefetch_hits",
             "tt_eval_hits", "prefetch_budget", "delta_evals",
-            "dedup_evals", "nodes",
+            "dedup_evals", "nodes", "anchor_deltas",
         )[:n])}
         # Service-side: slots actually transferred (size-bucketed) and
         # host->device payload bytes shipped (the compact wire's metric).
@@ -683,11 +706,19 @@ class SearchService:
                 if rows + 4 <= rt:
                     tier = rt
                     break
-            self._wire_bytes[t] += tier * 2 * 8 * 2 + size * 4 * 4
-            return self._eval_fn(
-                self._params, packed[:tier], offsets[:size], buckets[:size],
-                parents[:size], material[:size],
+            # Row offsets are derived ON DEVICE by cumsum over the
+            # parent codes (4 rows per full, 1 per delta); the emitted
+            # row count ships as a 4-byte scalar and padding entries
+            # clamp into the sentinel block at packed[rows:rows+4] —
+            # the offsets array is off the wire entirely
+            # (evaluate_packed_anchored).
+            self._wire_bytes[t] += tier * 2 * 8 * 2 + size * 3 * 4 + 4
+            values, self._anchor_tabs[group] = self._eval_fn(
+                self._params, packed[:tier], buckets[:size],
+                parents[:size], material[:size], self._anchor_tabs[group],
+                np.array([rows], np.int32),
             )
+            return values
         if self._sharded_packed:
             return self._dispatch_sharded_packed(
                 t, size, n, rows, packed, offsets, buckets, parents, material

@@ -71,6 +71,7 @@ def main() -> None:
         "nodes_per_eval": round(c["nodes"] / evals, 3),
         "evals_shipped": c["evals_shipped"],
         "delta_coverage": round(c["delta_evals"] / evals, 3),
+        "anchor_rate": round(c.get("anchor_deltas", 0) / evals, 3),
         "prefetch_roi": round(
             c["prefetch_hits"] / max(1, c["prefetch_shipped"]), 3
         ),
