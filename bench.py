@@ -227,17 +227,26 @@ def bench_realized_mix(params, captured: dict) -> dict:
     import numpy as np
 
     from fishnet_tpu.nnue import spec
-    from fishnet_tpu.nnue.jax_eval import evaluate_batch
+    from fishnet_tpu.nnue.jax_eval import (
+        _evaluate_from_acc,
+        anchor_ids_np,
+        is_delta_np,
+    )
+    from fishnet_tpu.ops.ft_gather import decode_parent, ft_accumulate
 
     indices = np.ascontiguousarray(captured["feats"].astype(np.int32))
     parent = captured["parents"]
     buckets = captured["buckets"]
     material = captured["material"]
     size = len(buckets)
+    # Replay with a live anchor table so the persistent-delta entries'
+    # row DMAs and the store scatter are priced like production.
+    tab_rows = int(anchor_ids_np(parent).max()) + 1
 
     @jax.jit
-    def eval_loop(params, indices, buckets, parent, material, rounds):
-        def body(i, acc):
+    def eval_loop(params, indices, buckets, parent, material, tab, rounds):
+        def body(i, carry):
+            acc_sum, tab = carry
             pert = (i * 97) % spec.NUM_FEATURES
             is_plain = indices < spec.NUM_FEATURES
             is_delta = (indices >= spec.DELTA_BASE) & (
@@ -251,17 +260,30 @@ def bench_realized_mix(params, captured: dict) -> dict:
                 idx,
             )
             b = (buckets + i) % spec.NUM_PSQT_BUCKETS
-            return acc + evaluate_batch(params, idx, b, parent, material).sum()
+            acc = ft_accumulate(
+                params["ft_w"], params["ft_b"], idx,
+                delta_base=spec.DELTA_BASE, parent=parent, anchor_tab=tab,
+            )
+            vals = _evaluate_from_acc(params, acc, idx, b, parent, material)
+            _, _, stores, _, _, aid = decode_parent(parent)
+            row = jnp.where(stores, aid, tab.shape[0])
+            tab = tab.at[row].set(
+                acc.reshape(parent.shape[0], 2, -1), mode="drop"
+            )
+            return acc_sum + vals.sum(), tab
 
-        return jax.lax.fori_loop(0, rounds, body, jnp.int32(0))
+        return jax.lax.fori_loop(
+            0, rounds, body, (jnp.int32(0), tab)
+        )[0]
 
+    tab0 = jnp.zeros((tab_rows, 2, spec.L1), jnp.int32)
     d = [jax.device_put(jnp.asarray(x)) for x in (indices, buckets, parent, material)]
     r1, r2 = 2, 2 + 64 * max(1, 16384 // size)
-    int(eval_loop(params, d[0], d[1], d[2], d[3], r1))  # compile + warm
+    int(eval_loop(params, d[0], d[1], d[2], d[3], tab0, r1))  # compile + warm
 
     def timed(rounds: int) -> float:
         t0 = time.perf_counter()
-        int(eval_loop(params, d[0], d[1], d[2], d[3], rounds))
+        int(eval_loop(params, d[0], d[1], d[2], d[3], tab0, rounds))
         return time.perf_counter() - t0
 
     t_small = sorted(timed(r1) for _ in range(3))[1]
@@ -269,7 +291,10 @@ def bench_realized_mix(params, captured: dict) -> dict:
     per_eval_s = (t_big - t_small) / (r2 - r1)
     out = {
         "batch": size,
-        "delta_share": round(float((parent >= 0).mean()), 4),
+        "delta_share": round(float(is_delta_np(parent).mean()), 4),
+        "anchor_share": round(
+            float((is_delta_np(parent) & (parent <= -2)).mean()), 4
+        ),
     }
     if "packed_rows" in captured:
         # Wire cost of this batch under the compact format vs dense.
@@ -515,12 +540,10 @@ def traffic_report(counters: dict, total_nodes: int) -> dict:
         "delta_coverage": round(
             counters.get("delta_evals", 0) / shipped, 4
         ),
-        # Requests answered by in-step dedup (identical position already
-        # in the same batch — adjacent-ply searches collide in-step).
-        "dedup_rate": round(
-            counters.get("dedup_evals", 0)
-            / max(1, counters.get("dedup_evals", 0) + shipped),
-            4,
+        # ... of which deltas against DEVICE-RESIDENT anchors (entry-0
+        # demand evals riding accumulators stored in a previous step).
+        "anchor_coverage": round(
+            counters.get("anchor_deltas", 0) / shipped, 4
         ),
     }
 
@@ -636,8 +659,7 @@ def make_workload(n_batches: int, per_batch: int, seed: int = 99):
     each search gets (root_fen, moves_prefix) exactly like a real
     acquire payload — so concurrent fibers work on DISTINCT positions
     (adjacent plies of the same game share subtrees through the TT and
-    collide in-step on transpositions, which is what the pool's dedup
-    and the TT are for). A workload of one position duplicated
+    collide in-step on transpositions, which is what the TT is for). A workload of one position duplicated
     per_batch times would measure redundancy, not throughput."""
     import random
 
@@ -755,7 +777,17 @@ def main() -> None:
     )
 
     log("bench: creating search service (jax backend)...")
-    weights = NnueWeights.random(seed=7)
+    # The e2e tier runs the MATERIAL-CORRELATED net (round 5): every
+    # production engine net tracks material, and the search keys real
+    # behavior on that property — the SEE/pruning tiers and the
+    # prediction-gated speculation (search.cpp filter_qsearch_prefetch)
+    # are all disabled under a material-blind random net, so a random-
+    # net e2e measured a configuration the fleet never runs.
+    # FISHNET_BENCH_NET=random restores the old dev-mode measurement.
+    if _os.environ.get("FISHNET_BENCH_NET", "material") == "random":
+        weights = NnueWeights.random(seed=7)
+    else:
+        weights = material_weights()
     service = SearchService(
         weights=weights,
         pool_slots=n_searches + 256,
@@ -793,31 +825,35 @@ def main() -> None:
         # all-sentinel compile dummies can never be the capture.
         orig_eval = service._eval_fn
 
-        def capturing_eval(params, packed, offsets, buckets, parents, material):
+        def capturing_eval(params, packed, buckets, parents, material,
+                           anchor_tab, n_rows):
             # Key the capture on REAL entries (non-sentinel fulls +
             # deltas), not the padded bucket length: every large step
             # ships the same bucket size, and keying on it let drain-
             # tail batches (mostly padding) overwrite the steady-state
             # capture the tier exists to price.
             from fishnet_tpu.nnue import spec as _spec
+            from fishnet_tpu.nnue.jax_eval import (
+                derive_offsets_np,
+                expand_packed_np,
+                is_delta_np,
+            )
 
             p = np.asarray(parents)
-            off = np.clip(np.asarray(offsets), 0, len(packed) - 1)
-            first = np.asarray(packed)[off, 0, 0]
-            real_n = int(((p >= 0) | (first != _spec.NUM_FEATURES)).sum())
+            off = derive_offsets_np(p, int(n_rows[0]))
+            first = np.asarray(packed)[np.minimum(off, len(packed) - 1), 0, 0]
+            real_n = int((is_delta_np(p) | (first != _spec.NUM_FEATURES)).sum())
             if real_n >= 4096 and real_n > captured.get("real_n", 0):
-                from fishnet_tpu.nnue.jax_eval import expand_packed_np
-
                 captured.update(
                     feats=expand_packed_np(
-                        np.asarray(packed), np.asarray(offsets),
-                        np.asarray(parents),
+                        np.asarray(packed), off, p
                     ).astype(np.int32),
                     buckets=np.array(buckets),
                     parents=np.array(parents), material=np.array(material),
                     packed_rows=len(packed), real_n=real_n,
                 )
-            return orig_eval(params, packed, offsets, buckets, parents, material)
+            return orig_eval(params, packed, buckets, parents, material,
+                             anchor_tab, n_rows)
 
         service._eval_fn = capturing_eval
         asyncio.run(run_searches(service, jobs[:8], 500))  # touch the pipeline once

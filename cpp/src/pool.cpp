@@ -36,8 +36,6 @@
 #include <memory>
 #include <mutex>
 #include <string>
-#include <tuple>
-#include <unordered_map>
 #include <vector>
 
 #include "fiber.h"
@@ -91,8 +89,8 @@ struct Slot {
   SearchResult result;
   // active/finished are written by the owning group's scheduler thread
   // but read cross-thread (fc_pool_active telemetry, submit routing):
-  // relaxed atomics. started/wants_eval/alias_pending stay plain bools
-  // — owner-thread only.
+  // relaxed atomics. started/wants_eval stay plain bools — owner-thread
+  // only.
   std::atomic<bool> active{false};   // submitted, not yet released
   std::atomic<bool> finished{false}; // search complete, result ready
   bool started = false;    // fiber launched
@@ -135,8 +133,7 @@ struct Slot {
   // stored in this slot's anchor-table row on the device. `pending_*`
   // snapshots entry 0 of the block built most recently — it becomes the
   // slot's anchor when (and only when) that block is actually emitted
-  // (a block can wait several steps for batch capacity, and an aliased
-  // single never ships at all).
+  // (a block can wait several steps for batch capacity).
   bool anchor_valid = false;
   bool pending_anchor_valid = false;
   Position anchor_pos;
@@ -144,12 +141,6 @@ struct Slot {
   int32_t anchor_psqt[2][NNUE_PSQT_BUCKETS];
   int32_t pending_psqt[2][NNUE_PSQT_BUCKETS];
   int32_t eval_values[EVAL_BLOCK_MAX];
-  // Position hash per entry: the key for in-step deduplication.
-  uint64_t entry_hash[EVAL_BLOCK_MAX];
-  // True while this slot's single-entry request is aliased onto another
-  // entry of the in-flight batch (no slot of its own shipped); the
-  // step loops must not re-emit it until provide() fans the value out.
-  bool alias_pending = false;
 };
 
 namespace {
@@ -290,7 +281,6 @@ void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out)
       slot_->material[j] =
           (slot_->psqt[j][0][slot_->buckets[j]] -
            slot_->psqt[j][1][slot_->buckets[j]]) / 2;
-      slot_->entry_hash[j] = pos.hash;
     }
     if (*anchors_) {
       // Entry 0 becomes the slot's device anchor once this block ships
@@ -330,7 +320,6 @@ struct SearchPool {
   std::atomic<uint64_t> suspensions{0};    // fiber blocks (1 round-trip each)
   std::atomic<uint64_t> step_capacity{0};  // sum of capacities (occupancy denom)
   std::atomic<uint64_t> delta_evals{0};    // eval slots shipped as deltas
-  std::atomic<uint64_t> dedup_evals{0};    // requests served as aliases
   std::atomic<uint64_t> anchor_evals{0};   // deltas vs device-resident anchors
   // Persistent-anchor switch: set ONCE by the service (before traffic)
   // when its evaluator understands the anchor-table wire codes; plain
@@ -381,13 +370,6 @@ struct SearchPool {
   // (slot id, index within the slot's block) per entry of the group's
   // last step() eval batch, in emission order.
   std::vector<std::vector<std::pair<int, int>>> group_batch;
-  // In-step dedup aliases per group: (slot, block entry, batch index of
-  // the identical position already emitted this step). Production
-  // batches analyze CONSECUTIVE PLIES of one game, so concurrent
-  // fibers walk overlapping trees in lockstep and request the same
-  // leaf in the same step — the TT only dedups across steps (the eval
-  // lands there after provide). One slot ships; provide() fans out.
-  std::vector<std::vector<std::tuple<int, int, int>>> group_alias;
   // Finished-slot queues, one per group: filled by the owning thread's
   // step(), drained by the same thread's harvest loop.
   std::vector<std::deque<int>> group_finished;
@@ -404,7 +386,6 @@ struct SearchPool {
     for (auto& s : slots) s = std::make_unique<Slot>();
     n_groups = groups < 1 ? 1 : (groups > max_slots ? max_slots : groups);
     group_batch.resize(n_groups);
-    group_alias.resize(n_groups);
     group_finished.resize(n_groups);
     group_cursor.assign(n_groups, 0);
   }
@@ -504,7 +485,6 @@ int fc_pool_submit(SearchPool* pool, int group, const char* fen,
   slot.started = false;
   slot.finished = false;
   slot.wants_eval = false;
-  slot.alias_pending = false;
   slot.result = SearchResult();
   if (!slot.fiber) slot.fiber = std::make_unique<Fiber>(pool->fiber_stack);
   if (!slot.fiber->valid()) {
@@ -612,7 +592,7 @@ namespace {
 // the batch.
 // Result of trying to place one slot's eval block into the batch.
 enum EmitResult {
-  EMIT_OK = 0,        // emitted (or served as a dedup alias)
+  EMIT_OK = 0,        // emitted
   EMIT_FULL = 1,      // batch out of capacity: genuine pressure signal
   EMIT_MISALIGNED = 2 // block would straddle a shard boundary; NOT
                       // pressure — the AIMD budget must not react, or
@@ -621,8 +601,6 @@ enum EmitResult {
 
 EmitResult emit_block(SearchPool* pool,
                       std::vector<std::pair<int, int>>& batch,
-                      std::unordered_map<uint64_t, int>& seen,
-                      std::vector<std::tuple<int, int, int>>& aliases,
                       int i, uint16_t* out_packed, int32_t* out_offsets,
                       int32_t* out_buckets,
                       int32_t* out_slots, int32_t* out_parent,
@@ -630,21 +608,11 @@ EmitResult emit_block(SearchPool* pool,
                       int& row_cursor) {
   Slot& slot = *pool->slots[i];
   int base = int(batch.size());
-  // In-step dedup: a single-entry demand request whose position is
-  // already in this step's batch rides that entry instead of shipping
-  // a duplicate slot (same Zobrist key => same exact integer eval).
-  // Only singles alias: multi-entry blocks anchor the delta protocol
-  // by emission position, which aliasing entries would break.
-  if (slot.block_n == 1) {
-    auto it = seen.find(slot.entry_hash[0]);
-    if (it != seen.end()) {
-      pool->suspensions.fetch_add(1, std::memory_order_relaxed);
-      pool->dedup_evals.fetch_add(1, std::memory_order_relaxed);
-      slot.alias_pending = true;
-      aliases.emplace_back(i, 0, it->second);
-      return EMIT_OK;
-    }
-  }
+  // (In-step dedup used to alias identical single requests here; it was
+  // DELETED per VERDICT r4 item 8 — measured 0.05-0.3% of evals on
+  // production-shaped adjacent-ply workloads, while its hash-map build
+  // sat on the hot per-step host path. The TT already dedups across
+  // steps: the first eval lands there at provide time.)
   if (base + slot.block_n > capacity) return EMIT_FULL;  // next step
   // Shard alignment (sharded serving): a block must not straddle an
   // `align`-entry boundary, so every delta entry and its anchor land in
@@ -708,7 +676,6 @@ EmitResult emit_block(SearchPool* pool,
     } else {
       out_parent[idx] = -1;
     }
-    seen.emplace(slot.entry_hash[j], idx);  // dedup target for later singles
     batch.emplace_back(i, j);
   }
   // The block is on the wire: entry 0's accumulator is (about to be)
@@ -740,12 +707,7 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_packed,
                  int32_t* out_rows) {
   if (group < 0 || group >= pool->n_groups) group = 0;
   auto& batch = pool->group_batch[group];
-  auto& aliases = pool->group_alias[group];
   batch.clear();
-  aliases.clear();
-  // Position hash -> batch index emitted this step (dedup targets).
-  std::unordered_map<uint64_t, int> seen;
-  seen.reserve(size_t(capacity) * 2);
   const size_t n_slots = pool->slots.size();
   const int n_groups = pool->n_groups;
   size_t cursor = pool->group_cursor[group];
@@ -759,10 +721,8 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_packed,
     size_t i = (cursor + k) % n_slots;
     if (int(i) % n_groups != group) continue;
     Slot& slot = *pool->slots[i];
-    if (!slot.active || slot.finished || !slot.wants_eval ||
-        slot.alias_pending)
-      continue;
-    if (emit_block(pool, batch, seen, aliases, int(i), out_packed,
+    if (!slot.active || slot.finished || !slot.wants_eval) continue;
+    if (emit_block(pool, batch, int(i), out_packed,
                    out_offsets, out_buckets, out_slots, out_parent,
                    out_material, capacity, align, row_cursor) == EMIT_FULL)
       overflow = true;
@@ -809,7 +769,7 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_packed,
     } else if (slot.wants_eval) {
       // Blocks that don't fit stay suspended; phase 1 of the next step
       // picks them up first.
-      if (emit_block(pool, batch, seen, aliases, int(i), out_packed,
+      if (emit_block(pool, batch, int(i), out_packed,
                      out_offsets, out_buckets, out_slots, out_parent,
                      out_material, capacity, align, row_cursor) == EMIT_FULL)
         overflow = true;
@@ -924,7 +884,8 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_packed,
 // [6] prefetch hits                    [7] TT static-eval hits
 // [8] current prefetch budget (adaptive; instantaneous, not cumulative)
 // [9] eval slots shipped as incremental deltas (DMA-savings coverage)
-// [10] requests answered by in-step dedup (no slot shipped)
+// [10] RETIRED (was in-step dedup; always 0 — the alias machinery was
+//      deleted after measuring 0.05-0.3% on adjacent-ply workloads)
 // [11] search nodes visited, LIVE (bumped per node, not at finish) —
 //      lets telemetry compute steady-state nps over a time window
 //      without waiting for searches to complete
@@ -941,7 +902,7 @@ int fc_pool_counters(SearchPool* pool, uint64_t* out, int n) {
       pool->counters.tt_eval_hits.load(R),
       uint64_t(pool->prefetch_budget.load(R)),
       pool->delta_evals.load(R),
-      pool->dedup_evals.load(R),
+      0,  // retired dedup slot
       pool->counters.nodes.load(R),
       pool->anchor_evals.load(R),
   };
@@ -963,21 +924,6 @@ void fc_pool_provide(SearchPool* pool, int group, const int32_t* values, int n) 
     if (bidx == slot.block_n - 1) slot.wants_eval = false;  // runnable again
   }
   batch.clear();
-  // Fan the returned values out to deduplicated (aliased) requests.
-  for (auto& [sid, bidx, src] : pool->group_alias[group]) {
-    Slot& slot = *pool->slots[sid];
-    if (src >= n) {
-      // Partial provide dropped the alias target: release the alias so
-      // phase 1 re-emits the request next step (wants_eval stays set) —
-      // leaving alias_pending would strand the fiber forever.
-      slot.alias_pending = false;
-      continue;
-    }
-    slot.eval_values[bidx] = values[src];
-    slot.alias_pending = false;
-    if (bidx == slot.block_n - 1) slot.wants_eval = false;
-  }
-  pool->group_alias[group].clear();
 }
 
 // Number of slots still working (active and not finished) in `group`,

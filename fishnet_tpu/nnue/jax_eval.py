@@ -126,8 +126,16 @@ def _evaluate_from_acc(
 ) -> jax.Array:
     """The network head past the feature transformer: clipped pairwise
     multiply, bucketed dense stack, PSQT/material blend (see
-    evaluate_batch for semantics)."""
+    evaluate_batch for semantics). The on-device PSQT path resolves
+    IN-BATCH refs only — entries carrying persistent anchor codes must
+    ship a host-computed ``material`` (the anchor's PSQT lives host-side
+    in the pool slot, not in the device table)."""
     if material is None:
+        if parent is not None and not isinstance(parent, jax.core.Tracer):
+            if bool((np.asarray(parent) <= -2).any()):
+                raise ValueError(
+                    "persistent anchor codes require host-side material"
+                )
         if parent is None:
             psqt_rows = jnp.take(params["ft_psqt"], indices, axis=0)
             psqt = jnp.sum(psqt_rows, axis=2)  # [B, 2, 8] int32
@@ -353,6 +361,29 @@ def expand_packed_np(packed, offsets, parent):
     np.clip(rows, 0, len(packed) - 1, out=rows)
     g = packed[rows]  # [B, 4, 2, 8]
     dense = np.transpose(g, (0, 2, 1, 3)).reshape(-1, 2, 32).copy()
-    is_delta = np.asarray(parent) >= 0
-    dense[is_delta, :, 8:] = spec.NUM_FEATURES
+    dense[is_delta_np(parent), :, 8:] = spec.NUM_FEATURES
     return dense
+
+
+def is_delta_np(parent) -> "np.ndarray":
+    """NumPy twin of _is_delta (one-row entries under the wire codes)."""
+    parent = np.asarray(parent)
+    v = -parent - 2
+    return (parent >= 0) | ((parent <= -2) & ((v & 2) != 0))
+
+
+def anchor_ids_np(parent) -> "np.ndarray":
+    """NumPy twin of decode_parent's table-row extraction: the anchor
+    row for entries with anchor codes (<= -2), 0 elsewhere."""
+    parent = np.asarray(parent)
+    v = -parent - 2
+    return np.where(parent <= -2, v >> 2, 0)
+
+
+def derive_offsets_np(parent, n_rows: int) -> "np.ndarray":
+    """Host-side twin of the device's offset derivation: exclusive
+    cumsum of rows-per-entry (4 full / 1 delta), padding clamped to the
+    sentinel block at ``n_rows``."""
+    rows_per = np.where(is_delta_np(parent), 1, 4)
+    offsets = np.cumsum(rows_per) - rows_per
+    return np.minimum(offsets, n_rows).astype(np.int32)

@@ -439,6 +439,18 @@ def ft_accumulate(
             jax.default_backend() == "tpu" and ft_w.shape[1] % 1024 == 0
         )
     if parent is not None:
+        # Persistent codes REQUIRE a table: without one the kernel would
+        # DMA out of bounds against the 1-row dummy and the XLA fallback
+        # would silently return unresolved partials. Traced parents
+        # can't be inspected; concrete ones (every direct caller) are.
+        if anchor_tab is None and not isinstance(parent, jax.core.Tracer):
+            import numpy as _np
+
+            if bool((_np.asarray(parent) <= -2).any()):
+                raise ValueError(
+                    "parent contains persistent anchor codes but no "
+                    "anchor_tab was given"
+                )
         parent = parent.astype(jnp.int32)
         if use_pallas or interpret:
             # bit 0: sparse; bit 1: perspective swap vs the anchor;

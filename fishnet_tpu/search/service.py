@@ -602,7 +602,7 @@ class SearchService:
             "steps", "evals_shipped", "suspensions", "step_capacity",
             "demand_evals", "prefetch_shipped", "prefetch_hits",
             "tt_eval_hits", "prefetch_budget", "delta_evals",
-            "dedup_evals", "nodes", "anchor_deltas",
+            "dedup_retired", "nodes", "anchor_deltas",
         )[:n])}
         # Service-side: slots actually transferred (size-bucketed) and
         # host->device payload bytes shipped (the compact wire's metric).

@@ -371,22 +371,43 @@ def test_evaluate_packed_anchored_offsets_and_store():
     )
     vals, new_tab = np.asarray(vals), np.asarray(new_tab)
 
-    # Reference values: the explicit-offsets path with persistent codes
-    # resolved through the same anchor table via ft_accumulate — here
-    # recompute with evaluate_packed on a batch whose persistent entry
-    # is replaced by its resolved dense expansion is overkill; instead
-    # assert against a second anchored call (idempotent inputs) plus
-    # hand-check the two pure-wire entries via evaluate_packed.
-    pure = [0, 1, 3]  # entries with no table dependence
+    # Table-independent entries check against the explicit-offsets
+    # packed path (persistent codes stripped to their wire-equivalent
+    # plain forms).
+    pure = [0, 1, 3]
+    # All anchor codes map to plain fulls: entry 0's store-full IS a
+    # full, and the persistent entry (2, excluded from `pure`) merely
+    # decodes unused rows under its explicit offset.
     ref = np.asarray(
         evaluate_packed(
             params, jnp.asarray(packed), jnp.asarray(offsets),
             jnp.asarray(buckets),
-            jnp.asarray(np.where(parent == _pers_code(0, False), -1, parent)),
+            jnp.asarray(np.where(parent <= -2, -1, parent)),
             jnp.asarray(material),
         )
     )
     assert np.array_equal(vals[pure], ref[pure])
+    # The persistent entry (2) checks against the ft-level resolution
+    # (independently verified above) fed through the head directly —
+    # covering the integrated path's offsets derivation and expansion.
+    from fishnet_tpu.nnue.jax_eval import _evaluate_from_acc, expand_packed
+    from fishnet_tpu.ops.ft_gather import ft_accumulate
+
+    dense = expand_packed(
+        jnp.asarray(packed), jnp.asarray(offsets), jnp.asarray(parent)
+    )
+    acc = ft_accumulate(
+        params["ft_w"], params["ft_b"], dense, use_pallas=False,
+        delta_base=spec.DELTA_BASE, parent=jnp.asarray(parent),
+        anchor_tab=jnp.asarray(tab),
+    )
+    head = np.asarray(
+        _evaluate_from_acc(
+            params, acc, dense, jnp.asarray(buckets), jnp.asarray(parent),
+            jnp.asarray(material),
+        )
+    )
+    assert vals[2] == head[2]
 
     # Store semantics: rows 0 (full-store) and 3 (persistent) updated,
     # rows 1-2 untouched.

@@ -205,10 +205,10 @@ async def test_eval_traffic_counters_and_adaptive_budget():
         assert c["steps"] > 0
         assert c["suspensions"] > 0
         # Requests (demand + speculative) are served either by a shipped
-        # batch slot or by an in-step dedup alias; nothing is dropped.
+        # batch slot; nothing is dropped.
         assert (
             c["demand_evals"] + c["prefetch_shipped"]
-            == c["evals_shipped"] + c["dedup_evals"]
+            == c["evals_shipped"]
         )
         assert c["evals_shipped"] <= c["step_capacity"]
         assert c["prefetch_hits"] <= c["prefetch_shipped"]

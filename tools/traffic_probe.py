@@ -80,7 +80,6 @@ def main() -> None:
         "tt_eval_hits": c["tt_eval_hits"],
         "suspensions_per_search": round(c["suspensions"] / searches, 1),
         "block_avg": round(evals / max(1, c["suspensions"]), 2),
-        "dedup_rate": round(c["dedup_evals"] / evals, 4),
         "steps": c["steps"],
         "wire_bytes_per_eval": round(c["wire_bytes"] / evals, 1),
         "occupancy": round(c["evals_shipped"] / max(1, c["bucket_slots"]), 3),
