@@ -788,11 +788,22 @@ def main() -> None:
         weights = NnueWeights.random(seed=7)
     else:
         weights = material_weights()
+    # Pipeline depth: >1 overlaps one group's HOST work (fiber stepping,
+    # feature extraction, emission — measured 200-400 ms/step on the
+    # 1-core box) with another group's wire round-trip. The device-
+    # dispatch probe alone says depth 1 on serialized tunnels, but the
+    # e2e step is host+wire SERIAL at depth 1, so splitting the batch
+    # can still win when host time rivals the RTT.
     service = SearchService(
         weights=weights,
         pool_slots=n_searches + 256,
         batch_capacity=BENCH_CAPACITY,
         tt_bytes=512 << 20,
+        # Default 2, measured best on the tunnel: depth 1 serializes
+        # host+wire (~76k nps median), depth 2 overlaps them (~86k at
+        # comparable weather), depth 4 over-splits the batch (~66k —
+        # per-step fixed costs dominate the 8k sub-batches).
+        pipeline_depth=int(_os.environ.get("FISHNET_BENCH_PIPELINE", 2)),
         eval_sizes=tuple(
             s for s in (1024, 4096, 16384, BENCH_CAPACITY) if s <= BENCH_CAPACITY
         ),

@@ -125,10 +125,11 @@ def build_sharded_evaluator(opt: Opt, weights, logger: Logger):
 def build_search_service(opt: Opt, logger: Logger):
     """The shared batched-search backend, from CLI options (dev-mode
     random weights when no --nnue-file is given). Without --pipeline the
-    depth is probed: overlapping transports (locally attached TPUs) get
-    a multi-batch pipeline, serialized tunnels stay at depth 1. With >1
-    visible device (or an explicit --mesh) eval batches are sharded over
-    a device mesh instead of riding one chip."""
+    depth is probed for DEVICE dispatch overlap and floored at 2: even
+    on fully serialized tunnels the host phase (fiber stepping, feature
+    extraction) overlaps the other group's wire wait. With >1 visible
+    device (or an explicit --mesh) eval batches are sharded over a
+    device mesh instead of riding one chip."""
     from fishnet_tpu.nnue.weights import NnueWeights
     from fishnet_tpu.search.service import SearchService, suggest_pipeline_depth
 
@@ -154,10 +155,16 @@ def build_search_service(opt: Opt, logger: Logger):
                 eval_fn=evaluator,
             )
         except Exception as err:  # noqa: BLE001 - probe is best-effort
-            logger.debug(f"Pipeline probe failed ({err!r}); using depth 1.")
-            depth = 1
-        if depth > 1:
-            logger.info(f"Device dispatch overlaps; pipelining {depth} eval batches.")
+            logger.debug(f"Pipeline probe failed ({err!r}); using depth 2.")
+            depth = None
+        # The probe only sees DEVICE dispatch overlap; the e2e step also
+        # contains the host phase (fiber stepping, feature extraction,
+        # emission) that depth >= 2 overlaps with the wire wait even on
+        # fully serialized transports — measured +12% e2e on the tunnel,
+        # where the probe alone says 1. Floor at 2; explicit --pipeline
+        # still pins any value.
+        depth = max(2, depth or 0)
+        logger.info(f"Pipelining {depth} eval batches (host/wire overlap).")
     return SearchService(
         weights=weights,
         net_path=opt.nnue_file,  # native pool reads the original file

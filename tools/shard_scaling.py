@@ -91,6 +91,75 @@ def main() -> None:
         lambda: np.asarray(evaluator(None, idx, buckets, parent, material))
     )
 
+    # PACKED WIRE at the VERDICT's 16k operating point: the same block
+    # structure shipped as the compact row stream — globally for the
+    # single-device jit, per-shard (tier-padded, shard-local offsets,
+    # exactly SearchService._dispatch_sharded_packed's layout) for the
+    # mesh — so the ratio prices the whole sharded packed path incl.
+    # its on-device expansion.
+    from fishnet_tpu.nnue.jax_eval import evaluate_packed_jit
+
+    pbatch = 16384
+    pshard = pbatch // n_dev
+    pevaluator = ShardedEvaluator(params, mesh=mesh, batch_capacity=pbatch)
+    pidx, pparent, _ = _block_batch(
+        spec.NUM_FEATURES, spec.MAX_ACTIVE_FEATURES, pbatch // 8, 8, rng
+    )
+    pidx, pparent = np.asarray(pidx), np.asarray(pparent)
+    pbuckets = rng.integers(0, 8, pbatch).astype(np.int32)
+    pmaterial = rng.integers(-2000, 2000, pbatch).astype(np.int32)
+    # Pack: full entries own 4 rows of [2, 8], deltas 1 (their live
+    # slots are indices [:, :8] by the wire contract; is_delta_np is
+    # the shared wire-code predicate, persistent codes included).
+    from fishnet_tpu.nnue.jax_eval import is_delta_np
+
+    rows_per = np.where(is_delta_np(pparent), 1, 4)
+    g_off = (np.cumsum(rows_per) - rows_per).astype(np.int32)
+    g_rows = int(rows_per.sum())
+    g_packed = np.full((g_rows + 4, 2, 8), spec.NUM_FEATURES, np.uint16)
+    for e in range(pbatch):
+        if rows_per[e] == 1:
+            g_packed[g_off[e]] = pidx[e, :, :8]
+        else:
+            g_packed[g_off[e] : g_off[e] + 4] = (
+                pidx[e].reshape(2, 4, 8).transpose(1, 0, 2)
+            )
+    # Per-shard stream: every shard's rows padded to one common tier.
+    shard_rows = int(rows_per[:pshard].sum())  # uniform block structure
+    tier = next(
+        t for t in (2 * pshard + 4, 3 * pshard + 4, 4 * pshard + 4)
+        if shard_rows + 4 <= t
+    )
+    s_packed = np.full(
+        (n_dev * tier, 2, 8), spec.NUM_FEATURES, np.uint16
+    )
+    s_off = np.empty(pbatch, np.int32)
+    for d in range(n_dev):
+        lo, hi = d * pshard, (d + 1) * pshard
+        rs, re = g_off[lo], g_off[hi - 1] + rows_per[hi - 1]
+        s_packed[d * tier : d * tier + (re - rs)] = g_packed[rs:re]
+        s_off[lo:hi] = g_off[lo:hi] - rs
+    single_packed_s = timed(
+        lambda: np.asarray(
+            evaluate_packed_jit(
+                params, g_packed, g_off, pbuckets, pparent, pmaterial
+            )
+        )
+    )
+    sharded_packed_s = timed(
+        lambda: np.asarray(
+            pevaluator.packed_eval(
+                None, s_packed, s_off, pbuckets, pparent, pmaterial
+            )
+        )
+    )
+    wire_packed = int(s_packed.nbytes + s_off.nbytes + pbuckets.nbytes
+                      + pparent.nbytes + pmaterial.nbytes)
+    wire_dense = int(
+        pbatch * 2 * spec.MAX_ACTIVE_FEATURES * 2 + pbuckets.nbytes
+        + pparent.nbytes + pmaterial.nbytes
+    )
+
     print(
         json.dumps(
             {
@@ -100,6 +169,19 @@ def main() -> None:
                 "single_ms_per_step": round(single_s * 1e3, 3),
                 "sharded_ms_per_step": round(sharded_s * 1e3, 3),
                 "sharded_over_single": round(sharded_s / single_s, 3),
+                "packed_16k": {
+                    "batch": pbatch,
+                    "shard": pshard,
+                    "row_tier": tier,
+                    "single_ms_per_step": round(single_packed_s * 1e3, 3),
+                    "sharded_ms_per_step": round(sharded_packed_s * 1e3, 3),
+                    "sharded_over_single": round(
+                        sharded_packed_s / single_packed_s, 3
+                    ),
+                    "wire_bytes_packed": wire_packed,
+                    "wire_bytes_dense": wire_dense,
+                    "wire_ratio": round(wire_packed / wire_dense, 3),
+                },
                 "note": (
                     "8 virtual devices on 1 physical core: ratio ~1.0 = "
                     "no per-position overhead added by sharding (no "
