@@ -263,7 +263,16 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         stats=stats,
         backlog=BacklogOpt(user=opt.user_backlog, system=opt.system_backlog),
         max_backoff=opt.resolved_max_backoff(),
+        workers=opt.resolved_workers(),
     )
+    if opt.resolved_workers() != opt.resolved_cores():
+        shared = opt.resolved_engine() in ("tpu-nnue", "az-mcts")
+        what = ("over the shared device service" if shared
+                else "(one engine instance per worker)")
+        logger.info(
+            f"Analyzing up to {opt.resolved_workers()} positions "
+            f"concurrently {what}."
+        )
 
     stop = asyncio.Event()
     sigints = 0

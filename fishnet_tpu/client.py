@@ -122,6 +122,15 @@ class Client:
     stats: Optional[StatsRecorder] = None
     backlog: Optional[BacklogOpt] = None
     max_backoff: float = 30.0
+    # Worker (pull-loop) count; None = one per core, the reference's
+    # model, right for engines where a worker OWNS a CPU-bound engine
+    # (uci subprocesses, mock). Batched device engines (tpu-nnue,
+    # az-mcts) share ONE service whose pool serves hundreds of
+    # concurrent searches — there a worker is just an async pull loop,
+    # and running many per core is what analyzes a batch's ~30
+    # positions CONCURRENTLY instead of one per device round-trip
+    # (__main__ sets this from --search-concurrency / an auto default).
+    workers: Optional[int] = None
 
     _tasks: List[asyncio.Task] = field(default_factory=list)
     _queue_stub: Optional[queue_mod.QueueStub] = None
@@ -145,7 +154,7 @@ class Client:
         self._queue_stub = queue_stub
         self._tasks.append(asyncio.create_task(queue_actor.run(), name="queue"))
 
-        for i in range(self.cores):
+        for i in range(self.cores if self.workers is None else self.workers):
             self._tasks.append(
                 asyncio.create_task(
                     worker(i, self.engine_factory, queue_stub, self.logger),

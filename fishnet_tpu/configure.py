@@ -191,6 +191,14 @@ class Opt:
     #: the reference gets the same from one engine process per core,
     #: src/main.rs:158-170). Default: the resolved worker-core count.
     search_threads: Optional[int] = None
+    #: Worker (pull-loop) count. None = auto: batched device engines
+    #: (tpu-nnue, az-mcts) run many pull loops per core — a worker there
+    #: is an asyncio task over one SHARED device service, so concurrency
+    #: is set by the service's pool, not by host cores, and a batch's
+    #: ~30 positions analyze concurrently instead of one per device
+    #: round-trip; subprocess/mock engines keep the reference's
+    #: one-worker-per-core model.
+    search_concurrency: Optional[int] = None
     #: Device-mesh policy for the serving evaluator: "auto" (shard the
     #: eval batch whenever >1 device is visible), "off" (single device),
     #: or an explicit "DATAxMODEL" shape such as "4x2".
@@ -217,6 +225,13 @@ class Opt:
     def resolved_search_threads(self) -> int:
         if self.search_threads is not None:
             return self.search_threads
+        return self.resolved_cores()
+
+    def resolved_workers(self) -> int:
+        if self.search_concurrency is not None:
+            return self.search_concurrency
+        if self.resolved_engine() in ("tpu-nnue", "az-mcts"):
+            return min(256, 32 * self.resolved_cores())
         return self.resolved_cores()
 
     def resolved_mesh(self) -> str:
@@ -269,6 +284,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--search-threads", type=int, default=None,
                    help="Scheduler threads driving the search pool (host "
                         "parallelism tier). Default: the worker-core count.")
+    p.add_argument("--search-concurrency", type=int, default=None,
+                   help="Concurrent position analyses (worker pull loops). "
+                        "Default: 32 per core for the batched device engines "
+                        "(they share one service; a batch's positions analyze "
+                        "concurrently), 1 per core for uci/mock.")
     p.add_argument("--mesh", default=None,
                    help="Device mesh for the serving evaluator: auto (default; "
                         "shard eval batches over all visible devices), off "
@@ -314,6 +334,10 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
         if ns.search_threads < 1:
             raise ConfigError("--search-threads must be >= 1")
         opt.search_threads = ns.search_threads
+    if ns.search_concurrency is not None:
+        if ns.search_concurrency < 1:
+            raise ConfigError("--search-concurrency must be >= 1")
+        opt.search_concurrency = ns.search_concurrency
     if ns.mesh is not None:
         opt.mesh = parse_mesh(ns.mesh)
     return opt
@@ -336,8 +360,17 @@ _INI_FIELDS = (
     ("NnueFile", "nnue_file", str),
     ("AzNetFile", "az_net_file", str),
     ("Mesh", "mesh", parse_mesh),
-    ("SearchThreads", "search_threads", int),
+    ("SearchThreads", "search_threads", lambda v: _positive_int(v, "SearchThreads")),
+    ("SearchConcurrency", "search_concurrency",
+     lambda v: _positive_int(v, "SearchConcurrency")),
 )
+
+
+def _positive_int(value: str, name: str) -> int:
+    n = int(value)
+    if n < 1:
+        raise ConfigError(f"{name} must be >= 1")
+    return n
 
 
 def _bad_engine(s: str) -> str:

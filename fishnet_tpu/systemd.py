@@ -80,6 +80,8 @@ def _exec_start(opt: Opt, *, absolute: bool) -> str:
         args += ["--pipeline", str(opt.pipeline)]
     if opt.search_threads is not None:
         args += ["--search-threads", str(opt.search_threads)]
+    if opt.search_concurrency is not None:
+        args += ["--search-concurrency", str(opt.search_concurrency)]
     if opt.mesh is not None:
         args += ["--mesh", opt.mesh]
 
