@@ -1,0 +1,24 @@
+# Repo-level developer entry points. The native core's own build lives
+# in cpp/Makefile; this file only aliases the checker/test harnesses
+# that CI and doc/static-analysis.md reference.
+
+PYTHON ?= python
+
+.PHONY: analysis sanitize-smoke sanitize test tier1
+
+# Project-invariant static checker (R1-R4); exit 0 = clean tree.
+analysis:
+	$(PYTHON) -m fishnet_tpu.analysis
+
+# ASan+UBSan pool stress incl. the anchor full-provide guard case —
+# the non-tier-1 `slow` job.
+sanitize-smoke:
+	$(PYTHON) -m pytest tests/test_sanitizers.py -q -m slow
+
+# Full sanitizer sweep (adds TSan; ~10x wall clock).
+sanitize:
+	tools/sanitize.sh
+
+# Tier-1 test suite (CPU, 8 virtual devices).
+test tier1:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

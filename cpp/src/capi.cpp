@@ -39,7 +39,9 @@ extern "C" {
 // change; the Python binder refuses mismatched libraries (a stale
 // prebuilt tier .so with an old layout would otherwise corrupt memory
 // through shifted arguments).
-int fc_abi_version() { return 7; }
+// 8: fc_pool_provide returns int (entries consumed / -1 on a
+//    full-provide contract violation with anchors enabled).
+int fc_abi_version() { return 8; }
 
 int fc_init() {
   init_bitboards();

@@ -707,6 +707,30 @@ int fc_pool_step(SearchPool* pool, int group, uint16_t* out_packed,
                  int32_t* out_rows) {
   if (group < 0 || group >= pool->n_groups) group = 0;
   auto& batch = pool->group_batch[group];
+  // Defensive repair for the step-without-provide contract breach: a
+  // stale batch here means the previous step's values never arrived, so
+  // its blocks re-emit below (phase 1). With anchors enabled, an
+  // entry-0 persistent delta would then resolve against the anchor row
+  // its FIRST emission already refreshed — i.e. against itself. Rebuild
+  // such entries as full fills (anchor_pos holds entry 0's own
+  // position, committed at emission) and invalidate the slot's device
+  // anchor so later blocks reseed instead of diffing against a row
+  // whose content is now unknown.
+  if (pool->anchors_enabled && !batch.empty() && pool->scalar_net) {
+    for (auto [sid, bidx] : batch) {
+      if (bidx != 0) continue;
+      Slot& slot = *pool->slots[sid];
+      if (!slot.wants_eval) continue;
+      if (slot.parent_code[0] <= PARENT_PERSISTENT) {
+        fill_full(&slot, pool->scalar_net.get(), 0, slot.anchor_pos);
+        slot.material[0] =
+            (slot.psqt[0][0][slot.buckets[0]] -
+             slot.psqt[0][1][slot.buckets[0]]) / 2;
+      }
+      slot.anchor_valid = false;
+      slot.pending_anchor_valid = false;
+    }
+  }
   batch.clear();
   const size_t n_slots = pool->slots.size();
   const int n_groups = pool->n_groups;
@@ -914,16 +938,29 @@ int fc_pool_counters(SearchPool* pool, uint64_t* out, int n) {
 // Provide centipawn scores for the group's last step() batch, in order.
 // A fiber resumes (on the group's next fc_pool_step) once its whole
 // block has values; the service always provides all n requested.
-void fc_pool_provide(SearchPool* pool, int group, const int32_t* values, int n) {
+//
+// Returns the number of entries consumed, or -1 on a FULL-PROVIDE
+// contract violation: with persistent anchors enabled (fc_pool_set_
+// anchors), a provide with n != the step's batch size is REFUSED and
+// consumes nothing — a partial provide would re-emit blocks whose
+// entry-0 persistent delta references an anchor-table row the first
+// emission already overwrote, silently corrupting device anchor state
+// (ADVICE r5 #1). The caller may retry with the full batch; the batch
+// mapping is left intact. Without anchors the legacy lenient behavior
+// is kept (clamp to the batch, consume, clear).
+int fc_pool_provide(SearchPool* pool, int group, const int32_t* values, int n) {
   if (group < 0 || group >= pool->n_groups) group = 0;
   auto& batch = pool->group_batch[group];
-  for (int i = 0; i < n && i < int(batch.size()); i++) {
+  if (pool->anchors_enabled && n != int(batch.size())) return -1;
+  int consumed = n < int(batch.size()) ? n : int(batch.size());
+  for (int i = 0; i < consumed; i++) {
     auto [sid, bidx] = batch[i];
     Slot& slot = *pool->slots[sid];
     slot.eval_values[bidx] = values[i];
     if (bidx == slot.block_n - 1) slot.wants_eval = false;  // runnable again
   }
   batch.clear();
+  return consumed;
 }
 
 // Number of slots still working (active and not finished) in `group`,

@@ -39,6 +39,9 @@ int fc_pool_submit(SearchPool* pool, int group, const char* fen,
                    const char* moves, uint64_t nodes, int depth, int multipv,
                    int skill, int use_scalar, int variant);
 void fc_pool_stop_all(SearchPool* pool);
+void fc_pool_abort_all(SearchPool* pool);
+void fc_pool_set_anchors(SearchPool* pool, int enable);
+int fc_pool_provide(SearchPool* pool, int group, const int32_t* values, int n);
 int fc_pool_step(SearchPool* pool, int group, uint16_t* packed,
                  int32_t* offsets, int32_t* buckets, int32_t* slots,
                  int32_t* parent, int32_t* material, int capacity, int align,
@@ -70,6 +73,103 @@ const char* HORDE_START =
     "rnbqkbnr/pppppppp/8/1PP2PP1/PPPPPPPP/PPPPPPPP/PPPPPPPP/PPPPPPPP w kq - 0 1";
 const char* RK_START = "8/8/8/8/8/8/krbnNBRK/qrbnNBRQ w - - 0 1";
 
+// Unit phase: the persistent-anchor FULL-PROVIDE contract
+// (fc_pool_provide refuses partial provides with anchors enabled, and
+// fc_pool_step's stale-batch repair keeps a step-without-provide from
+// re-emitting self-referential anchor deltas). Needs a net: standard
+// batched searches walk the PSQT table host-side.
+int provide_guard_check(const char* net_path) {
+  SearchPool* pool = fc_pool_new(/*slots=*/8, /*tt_bytes=*/1 << 20,
+                                 net_path, /*n_groups=*/1);
+  if (!pool) {
+    std::fprintf(stderr, "provide-guard: fc_pool_new failed\n");
+    return 1;
+  }
+  fc_pool_set_anchors(pool, 1);
+  for (int i = 0; i < 2; i++) {
+    int rc = fc_pool_submit(pool, 0, MIDGAME, "", /*nodes=*/4000,
+                            /*depth=*/6, /*multipv=*/1, /*skill=*/20,
+                            /*use_scalar=*/0, fc::VR_STANDARD);
+    if (rc < 0) {
+      std::fprintf(stderr, "provide-guard: submit failed (%d)\n", rc);
+      fc_pool_free(pool);
+      return 1;
+    }
+  }
+  std::vector<uint16_t> packed((4 * CAPACITY + 4) * 2 * 8);
+  std::vector<int32_t> offsets(CAPACITY), buckets(CAPACITY), slots(CAPACITY),
+      parent(CAPACITY), material(CAPACITY), values(CAPACITY, 0);
+  int32_t rows = 0;
+  int failures = 0;
+  bool exercised_partial = false, exercised_stale = false;
+  for (int iter = 0; iter < 2000 && fc_pool_active(pool, 0) > 0; iter++) {
+    int n = fc_pool_step(pool, 0, packed.data(), offsets.data(),
+                         buckets.data(), slots.data(), parent.data(),
+                         material.data(), CAPACITY, 0, &rows);
+    if (n <= 0) continue;
+    if (!exercised_partial) {
+      // A partial provide must be refused outright and consume nothing.
+      if (fc_pool_provide(pool, 0, values.data(), n - 1) != -1) {
+        std::fprintf(stderr,
+                     "provide-guard: partial provide was not refused\n");
+        failures++;
+      }
+      exercised_partial = true;
+      if (fc_pool_provide(pool, 0, values.data(), n) != n) {
+        std::fprintf(stderr, "provide-guard: full retry not accepted\n");
+        failures++;
+      }
+      continue;
+    }
+    if (!exercised_stale) {
+      // Step WITHOUT providing: the stale-batch repair must rebuild
+      // re-emitted persistent entry-0 deltas as plain fulls (no wire
+      // code <= -2 carrying the delta bit may survive the repair).
+      int n2 = fc_pool_step(pool, 0, packed.data(), offsets.data(),
+                            buckets.data(), slots.data(), parent.data(),
+                            material.data(), CAPACITY, 0, &rows);
+      for (int i = 0; i < n2; i++) {
+        int32_t v = -parent[i] - 2;
+        if (parent[i] <= -2 && (v & 2) != 0) {
+          std::fprintf(stderr,
+                       "provide-guard: persistent delta survived the "
+                       "stale-batch repair (entry %d code %d)\n",
+                       i, parent[i]);
+          failures++;
+        }
+      }
+      exercised_stale = true;
+      n = n2;
+      if (n <= 0) continue;
+    }
+    if (fc_pool_provide(pool, 0, values.data(), n) != n) {
+      std::fprintf(stderr, "provide-guard: full provide rejected\n");
+      failures++;
+      break;
+    }
+  }
+  if (!exercised_partial) {
+    std::fprintf(stderr, "provide-guard: no eval batch was ever emitted\n");
+    failures++;
+  }
+  int slot;
+  while ((slot = fc_pool_next_finished(pool, 0)) >= 0) fc_pool_release(pool, slot);
+  fc_pool_abort_all(pool);
+  while (fc_pool_active(pool, 0) > 0) {
+    int n = fc_pool_step(pool, 0, packed.data(), offsets.data(),
+                         buckets.data(), slots.data(), parent.data(),
+                         material.data(), CAPACITY, 0, &rows);
+    if (n > 0 && fc_pool_provide(pool, 0, values.data(), n) != n) break;
+    while ((slot = fc_pool_next_finished(pool, 0)) >= 0)
+      fc_pool_release(pool, slot);
+  }
+  fc_pool_free(pool);
+  if (failures == 0)
+    std::printf("provide-guard: full-provide contract enforced "
+                "(partial refused, stale batch repaired)\n");
+  return failures ? 1 : 0;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -77,6 +177,10 @@ int main(int argc, char** argv) {
   const int per_thread = argc > 2 ? std::atoi(argv[2]) : 48;
   const int n_threads = argc > 3 ? std::atoi(argv[3]) : 4;
   const bool have_net = net_path[0] != '\0';
+
+  // Anchor-contract unit phase first (single-threaded, needs the net's
+  // PSQT table for batched feature extraction).
+  if (have_net && provide_guard_check(net_path) != 0) return 1;
 
   // Small TT on purpose: eviction (the racier path — victim ranking,
   // generation reads, XOR re-stores) must fire constantly.

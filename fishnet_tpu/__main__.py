@@ -370,9 +370,11 @@ async def run_client(opt: Opt, logger: Logger) -> None:
                 logger.error(f"Update promotion failed: {err}")
                 ok = False
         elif restart_to.command:
-            import subprocess
-
-            rc = subprocess.run(restart_to.command).returncode
+            # Async subprocess (R1): run_client is still on the event
+            # loop here; even post-drain, a sync subprocess.run would
+            # block signal handlers and any late api-actor I/O.
+            proc = await asyncio.create_subprocess_exec(*restart_to.command)
+            rc = await proc.wait()
             if rc != 0:
                 logger.error(f"Update command failed with exit code {rc}.")
                 ok = False

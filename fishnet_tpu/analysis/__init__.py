@@ -1,0 +1,37 @@
+"""Project-invariant static analysis for fishnet-tpu.
+
+The reference fishnet ships zero tests and keeps its contracts in
+comments ("don't hold it wrong"); this package makes the contracts that
+actually bit us machine-checked.  It is an AST-based rule engine with
+four project-specific rules:
+
+* **R1 async-blocking** — no blocking calls (``time.sleep``,
+  ``subprocess.run``, sync ``requests``/``socket`` I/O,
+  ``Popen(...).communicate``) inside ``async def`` bodies.  One blocking
+  call on the event loop stalls every worker's pull loop at once — the
+  exact bug class behind the PR-5 "one position at a time" stall.
+* **R2 jit-host-sync** — no host-synchronizing operations (``.item()``,
+  ``np.asarray``, ``jax.device_get``, ``float()``/``int()``/``bool()``
+  on arrays, Python branches on array truthiness) in code reachable from
+  a ``jax.jit``/``pjit``/``shard_map``/``pallas_call`` entry point.
+  Under tracing these either crash late or — worse — silently take the
+  trace-time branch and bake wrong values into the compiled program.
+* **R3 deprecated-jax** — no deprecated/private JAX API usage
+  (``jax.core.Tracer``, ``jax._src.*``); suggests pinned-version-safe
+  replacements.
+* **R4 cross-thread-state** — heuristic detection of instance/module
+  state mutated both from a driver thread and from asyncio/event-loop
+  methods without a lock or queue.
+
+Run ``python -m fishnet_tpu.analysis`` (exit 0 = clean); see
+``doc/static-analysis.md`` for rationale, worked examples, and the
+inline suppression syntax (``# fishnet: ignore[R2] -- justification``).
+"""
+
+from fishnet_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    Project,
+    check_paths,
+    iter_python_files,
+)
+from fishnet_tpu.analysis.rules import ALL_RULES  # noqa: F401

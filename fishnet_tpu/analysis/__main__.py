@@ -1,0 +1,78 @@
+"""CLI: ``python -m fishnet_tpu.analysis [paths...]``.
+
+With no paths, checks the installed ``fishnet_tpu`` package tree.
+Exit status: 0 = clean, 1 = findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from fishnet_tpu.analysis.engine import check_paths
+from fishnet_tpu.analysis.rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fishnet_tpu.analysis",
+        description="fishnet-tpu project-invariant static checker (R1-R4)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to check (default: the fishnet_tpu package)",
+    )
+    parser.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true", help="print only the summary line"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}  {rule.name}")
+        return 0
+
+    rules = ALL_RULES
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [r for r in ALL_RULES if r.id in wanted]
+        unknown = wanted - {r.id for r in ALL_RULES}
+        if unknown:
+            print(f"unknown rule ids: {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    if args.paths:
+        paths = [Path(p) for p in args.paths]
+        missing = [p for p in paths if not p.exists()]
+        if missing:
+            print(
+                f"no such path: {', '.join(str(p) for p in missing)}",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        paths = [Path(__file__).resolve().parent.parent]
+
+    findings = check_paths(paths, rules)
+    if not args.quiet:
+        for f in findings:
+            print(f.render())
+    n_files = len(
+        {f for p in paths for f in ([p] if p.is_file() else p.rglob("*.py"))}
+    )
+    status = "clean" if not findings else f"{len(findings)} finding(s)"
+    print(f"fishnet_tpu.analysis: {n_files} file(s) checked, {status}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

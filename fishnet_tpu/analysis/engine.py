@@ -1,0 +1,332 @@
+"""Rule engine: file collection, module indexing, scopes, suppressions.
+
+The engine parses every target file ONCE into an indexed ``Module``
+(functions with qualified names, per-scope import tables, recorded call
+sites) and hands the whole :class:`Project` to each rule — R2's
+reachability analysis needs cross-module edges (``evaluate_batch`` in
+``nnue/jax_eval.py`` calls ``ft_accumulate`` in ``ops/ft_gather.py``),
+so per-file rules alone cannot express the invariant.
+
+Nothing here imports the code under analysis: analysis is purely
+syntactic, so it runs in milliseconds, needs no device, and cannot be
+defeated by import-time side effects.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Inline suppression: ``# fishnet: ignore[R1,R2] -- why this is safe``.
+#: The justification after ``--`` is MANDATORY — an unexplained
+#: suppression is itself reported (rule SUP).
+_SUPPRESS_RE = re.compile(
+    r"#\s*fishnet:\s*ignore\[([A-Za-z0-9_,\s]+)\]\s*(?:--\s*(.*))?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suggestion: Optional[str] = None
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        if self.suggestion:
+            out += f"\n    hint: {self.suggestion}"
+        return out
+
+
+@dataclass(eq=False)  # identity semantics: used as dict keys in R2's BFS
+class FuncInfo:
+    """One function/method (async or not, any nesting level)."""
+
+    qualname: str  # e.g. "SearchService._drive" or "f.<locals>.g"
+    module: "Module"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    class_name: Optional[str] = None
+    #: alias -> dotted path, merged module + enclosing + own-scope imports
+    imports: Dict[str, str] = field(default_factory=dict)
+    #: local names bound to nested function defs: name -> qualname
+    locals_: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+
+@dataclass
+class Module:
+    path: Path
+    name: str  # dotted module name ("fishnet_tpu.nnue.jax_eval")
+    tree: ast.Module
+    source_lines: List[str]
+    imports: Dict[str, str] = field(default_factory=dict)  # module scope
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)
+    #: class name -> {method name -> qualname}
+    classes: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+
+class Project:
+    """All indexed modules plus name-resolution helpers shared by rules."""
+
+    def __init__(self, package_roots: Sequence[str] = ("fishnet_tpu",)):
+        self.modules: Dict[str, Module] = {}
+        self.package_roots = tuple(package_roots)
+
+    # -- construction -----------------------------------------------------
+
+    def add_file(self, path: Path) -> Optional[Module]:
+        src = path.read_text(encoding="utf-8", errors="replace")
+        try:
+            tree = ast.parse(src, filename=str(path))
+        except SyntaxError as err:
+            # Surfaced as a finding by check_paths; unparseable files
+            # cannot be certified clean.
+            raise _ParseError(path, err) from err
+        mod = Module(
+            path=path,
+            name=self._module_name(path),
+            tree=tree,
+            source_lines=src.splitlines(),
+        )
+        _Indexer(mod).visit(tree)
+        self.modules[mod.name] = mod
+        return mod
+
+    def _module_name(self, path: Path) -> str:
+        """Dotted name from the path, anchored at a known package root;
+        stand-alone files (test fixtures) get their stem."""
+        parts = list(path.with_suffix("").parts)
+        for root in self.package_roots:
+            if root in parts:
+                i = parts.index(root)
+                name = ".".join(parts[i:])
+                return name[: -len(".__init__")] if name.endswith(".__init__") else name
+        return path.stem
+
+    # -- resolution helpers ----------------------------------------------
+
+    def resolve_dotted(self, node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+        """Resolve a Name/Attribute chain to a dotted path using the
+        import table ("pl.pallas_call" -> "jax.experimental.pallas
+        .pallas_call").  Unresolvable heads fall back to the literal
+        chain, so intra-module names come back as themselves."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = imports.get(node.id, node.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+    def find_function(
+        self, dotted: str, current: Optional[Module] = None
+    ) -> Optional[FuncInfo]:
+        """Find a project function by resolved dotted path: bare names
+        search the current module; package-qualified names search the
+        owning module (module-level functions only)."""
+        if "." not in dotted:
+            if current is not None:
+                return current.functions.get(dotted)
+            return None
+        mod_name, _, func = dotted.rpartition(".")
+        mod = self.modules.get(mod_name)
+        if mod is not None:
+            return mod.functions.get(func)
+        return None
+
+
+class _ParseError(Exception):
+    def __init__(self, path: Path, err: SyntaxError):
+        super().__init__(str(err))
+        self.path = path
+        self.err = err
+
+
+class _Indexer(ast.NodeVisitor):
+    """Single pass: import tables per scope, functions with qualnames,
+    class method maps."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.scope: List[str] = []  # qualname parts
+        self.class_stack: List[str] = []
+        self.import_stack: List[Dict[str, str]] = [mod.imports]
+
+    # imports ------------------------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        table = self.import_stack[-1]
+        for alias in node.names:
+            table[alias.asname or alias.name.split(".")[0]] = (
+                alias.name if alias.asname else alias.name.split(".")[0]
+            )
+            if alias.asname:
+                table[alias.asname] = alias.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        table = self.import_stack[-1]
+        base = node.module or ""
+        if node.level:  # relative import: anchor at this module's package
+            pkg = self.mod.name.rsplit(".", node.level)[0]
+            base = f"{pkg}.{base}" if base else pkg
+        for alias in node.names:
+            table[alias.asname or alias.name] = (
+                f"{base}.{alias.name}" if base else alias.name
+            )
+
+    # defs ---------------------------------------------------------------
+
+    def _visit_func(self, node) -> None:
+        parent_is_class = bool(self.class_stack) and len(self.scope) == len(
+            self.class_stack
+        )
+        if self.scope and not parent_is_class:
+            qual = f"{self.scope[-1]}.<locals>.{node.name}"
+        elif parent_is_class:
+            qual = ".".join(self.class_stack + [node.name])
+        else:
+            qual = node.name
+        imports = dict(self.import_stack[-1])
+        info = FuncInfo(
+            qualname=qual,
+            module=self.mod,
+            node=node,
+            class_name=self.class_stack[-1] if parent_is_class else None,
+            imports=imports,
+        )
+        self.mod.functions[qual] = info
+        if parent_is_class:
+            self.mod.classes.setdefault(self.class_stack[-1], {})[node.name] = qual
+        # Expose nested defs to the enclosing function's resolution.
+        if self.scope and not parent_is_class:
+            encl = self.mod.functions.get(self.scope[-1])
+            if encl is not None:
+                encl.locals_[node.name] = qual
+
+        self.scope.append(qual)
+        self.import_stack.append(imports)
+        for child in node.body:
+            self.visit(child)
+        self.import_stack.pop()
+        self.scope.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.mod.classes.setdefault(node.name, {})
+        self.class_stack.append(node.name)
+        self.scope.append(node.name)
+        for child in node.body:
+            self.visit(child)
+        self.scope.pop()
+        self.class_stack.pop()
+
+
+# -- suppression handling -------------------------------------------------
+
+
+def _suppressions(lines: List[str]) -> Dict[int, Tuple[set, Optional[str], int]]:
+    """line number -> (rule ids, justification, comment line)."""
+    out: Dict[int, Tuple[set, Optional[str], int]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+        just = (m.group(2) or "").strip() or None
+        target = i
+        if text.strip().startswith("#") and i < len(lines):
+            # Stand-alone comment suppresses the NEXT line.
+            target = i + 1
+        out[target] = (rules, just, i)
+    return out
+
+
+def apply_suppressions(findings: List[Finding], mod: Module) -> List[Finding]:
+    sup = _suppressions(mod.source_lines)
+    out: List[Finding] = []
+    for f in findings:
+        entry = sup.get(f.line)
+        if entry is None:
+            out.append(f)
+            continue
+        rules, just, comment_line = entry
+        if f.rule not in rules and "ALL" not in rules:
+            out.append(f)
+            continue
+        if just is None:
+            out.append(
+                Finding(
+                    rule="SUP",
+                    path=f.path,
+                    line=comment_line,
+                    col=0,
+                    message=(
+                        f"suppression of {f.rule} without a justification "
+                        f"(write `# fishnet: ignore[{f.rule}] -- <why>`)"
+                    ),
+                )
+            )
+        # Justified: drop the finding.
+    return out
+
+
+# -- driver ---------------------------------------------------------------
+
+
+def iter_python_files(paths: Iterable[Path]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(q for q in p.rglob("*.py") if q.is_file()))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def check_paths(
+    paths: Iterable[Path], rules: Optional[Sequence] = None
+) -> List[Finding]:
+    """Index every file, run every rule, apply suppressions."""
+    from fishnet_tpu.analysis.rules import ALL_RULES
+
+    rules = list(rules if rules is not None else ALL_RULES)
+    project = Project()
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            project.add_file(path)
+        except _ParseError as err:
+            findings.append(
+                Finding(
+                    rule="AST",
+                    path=str(path),
+                    line=err.err.lineno or 1,
+                    col=err.err.offset or 0,
+                    message=f"file does not parse: {err.err.msg}",
+                )
+            )
+    for rule in rules:
+        per_module: Dict[str, List[Finding]] = {}
+        for f in rule.check(project):
+            per_module.setdefault(f.path, []).append(f)
+        for mod in project.modules.values():
+            mod_findings = per_module.pop(str(mod.path), [])
+            findings.extend(apply_suppressions(mod_findings, mod))
+        for leftovers in per_module.values():  # paths not indexed (rare)
+            findings.extend(leftovers)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

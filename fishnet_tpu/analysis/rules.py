@@ -1,0 +1,804 @@
+"""The four project rules (R1-R4). See package docstring and
+doc/static-analysis.md for rationale and worked examples.
+
+All rules operate on the indexed :class:`~fishnet_tpu.analysis.engine.
+Project`; none import the code under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from fishnet_tpu.analysis.engine import Finding, FuncInfo, Module, Project
+
+# ---------------------------------------------------------------------------
+# R1: blocking calls inside async def bodies
+# ---------------------------------------------------------------------------
+
+#: Fully-resolved callables that block the event loop.
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.getoutput",
+    "subprocess.getstatusoutput",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+}
+
+#: Module prefixes whose every call is synchronous network I/O.
+_BLOCKING_PREFIXES = ("requests.", "urllib.request.")
+
+#: Attribute calls that block unless awaited (asyncio's subprocess API
+#: has awaitable twins of both).
+_BLOCKING_METHODS = {"communicate"}
+
+
+class AsyncBlockingRule:
+    id = "R1"
+    name = "async-blocking"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules.values():
+            for info in mod.functions.values():
+                if not info.is_async:
+                    continue
+                yield from self._check_async_body(project, mod, info)
+
+    def _check_async_body(
+        self, project: Project, mod: Module, info: FuncInfo
+    ) -> Iterator[Finding]:
+        # Walk the async body but NOT nested sync defs/lambdas: those are
+        # values, typically shipped to executors (asyncio.to_thread),
+        # where blocking is the point.  Awaited calls are exempt from the
+        # method-name heuristic (asyncio's communicate/wait are fine).
+        awaited: Set[int] = set()
+        for node in _walk_own_body(info.node):
+            if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+                awaited.add(id(node.value))
+        for node in _walk_own_body(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = project.resolve_dotted(node.func, info.imports)
+            if dotted and (
+                dotted in _BLOCKING_CALLS
+                or dotted.startswith(_BLOCKING_PREFIXES)
+            ):
+                yield Finding(
+                    rule=self.id,
+                    path=str(mod.path),
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"blocking call `{dotted}` inside async function "
+                        f"`{info.qualname}` stalls the event loop (and with "
+                        "it every worker's pull loop)"
+                    ),
+                    suggestion=(
+                        "use the asyncio equivalent (asyncio.sleep, "
+                        "asyncio.create_subprocess_exec, aiohttp) or ship it "
+                        "off-loop via asyncio.to_thread(...)"
+                    ),
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_METHODS
+                and id(node) not in awaited
+            ):
+                yield Finding(
+                    rule=self.id,
+                    path=str(mod.path),
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"un-awaited `.{node.func.attr}()` inside async "
+                        f"function `{info.qualname}` — on a subprocess this "
+                        "blocks the event loop until the child exits"
+                    ),
+                    suggestion=(
+                        "use asyncio.create_subprocess_exec and `await "
+                        "proc.communicate()`"
+                    ),
+                )
+
+
+def _walk_own_body(func_node: ast.AST) -> Iterator[ast.AST]:
+    """Yield nodes of a function body without descending into nested
+    function definitions or lambdas (they execute in their own context)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# R2: host sync inside jit-traced code paths
+# ---------------------------------------------------------------------------
+
+#: Callables that wrap a function for tracing; their first argument (after
+#: unwrapping nested wrappers / functools.partial) becomes a trace root.
+_JIT_WRAPPERS = {
+    "jax.jit",
+    "jax.pjit",
+    "pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.shard_map",
+    "shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pallas.pallas_call",
+}
+
+_PARTIAL = {"functools.partial", "partial"}
+
+#: Resolved callables that force a device->host sync / concretization.
+_HOST_SYNC_CALLS = {
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+    "jax.device_get",
+}
+
+#: Concreteness guards: an `if` whose test calls one of these is a
+#: deliberate host-only region (executed at trace time on concrete
+#: inputs only) — its subtree is exempt from R2.  `isinstance` qualifies
+#: because branching on Python types can never branch on traced VALUES.
+_CONCRETENESS_GUARDS = {"is_concrete", "is_tracer", "isinstance", "is_concrete_array"}
+
+
+class JitHostSyncRule:
+    id = "R2"
+    name = "jit-host-sync"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        roots = self._find_roots(project)
+        reachable = self._reachable(project, roots)
+        for info, root in reachable.items():
+            yield from self._scan(project, info, root)
+
+    # -- root discovery ---------------------------------------------------
+
+    def _find_roots(self, project: Project) -> Dict[FuncInfo, str]:
+        roots: Dict[FuncInfo, str] = {}
+        for mod in project.modules.values():
+            # Decorators.
+            for info in mod.functions.values():
+                for dec in getattr(info.node, "decorator_list", []):
+                    if self._is_jit_wrapper(project, dec, info.imports):
+                        roots.setdefault(info, info.qualname)
+            # jax.jit(f) call sites anywhere in the module.
+            for info in mod.functions.values():
+                for node in _walk_own_body(info.node):
+                    self._roots_from_call(project, mod, info, node, roots)
+            # Module-level statements (evaluate_batch_jit = jax.jit(...)).
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    self._roots_from_call(project, mod, None, node, roots)
+        return roots
+
+    def _roots_from_call(self, project, mod, info, node, roots) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        imports = info.imports if info is not None else mod.imports
+        dotted = project.resolve_dotted(node.func, imports)
+        if dotted is None or dotted not in _JIT_WRAPPERS:
+            return
+        target = self._unwrap(project, node, imports)
+        if target is None:
+            return
+        fi = self._resolve_func_ref(project, mod, info, target)
+        if fi is not None:
+            roots.setdefault(fi, fi.qualname)
+
+    def _unwrap(self, project, call: ast.Call, imports) -> Optional[ast.AST]:
+        """First positional arg, unwrapping nested wrapper/partial calls."""
+        if not call.args:
+            return None
+        arg = call.args[0]
+        if isinstance(arg, ast.Call):
+            dotted = project.resolve_dotted(arg.func, imports)
+            if dotted in _JIT_WRAPPERS or dotted in _PARTIAL:
+                return self._unwrap(project, arg, imports)
+            return None
+        return arg
+
+    def _is_jit_wrapper(self, project, dec: ast.AST, imports) -> bool:
+        if isinstance(dec, ast.Call):
+            dotted = project.resolve_dotted(dec.func, imports)
+            if dotted in _JIT_WRAPPERS:
+                return True
+            if dotted in _PARTIAL and dec.args:
+                inner = project.resolve_dotted(dec.args[0], imports)
+                return inner in _JIT_WRAPPERS
+            return False
+        dotted = project.resolve_dotted(dec, imports)
+        return dotted in _JIT_WRAPPERS
+
+    def _resolve_func_ref(
+        self, project: Project, mod: Module, info: Optional[FuncInfo], node: ast.AST
+    ) -> Optional[FuncInfo]:
+        """Resolve a function REFERENCE (not call): bare name, nested def,
+        self.method, or imported project function."""
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            if node.value.id == "self" and info is not None and info.class_name:
+                methods = mod.classes.get(info.class_name, {})
+                qual = methods.get(node.attr)
+                if qual:
+                    return mod.functions.get(qual)
+        imports = info.imports if info is not None else mod.imports
+        dotted = project.resolve_dotted(node, imports)
+        if dotted is None:
+            return None
+        if info is not None and dotted in info.locals_:
+            return mod.functions.get(info.locals_[dotted])
+        return project.find_function(dotted, mod)
+
+    # -- reachability -----------------------------------------------------
+
+    def _reachable(
+        self, project: Project, roots: Dict[FuncInfo, str]
+    ) -> Dict[FuncInfo, str]:
+        seen: Dict[FuncInfo, str] = {}
+        stack = [(info, root) for info, root in roots.items()]
+        while stack:
+            info, root = stack.pop()
+            if info in seen:
+                continue
+            seen[info] = root
+            for callee in self._callees(project, info):
+                if callee not in seen:
+                    stack.append((callee, root))
+        return seen
+
+    def _callees(self, project: Project, info: FuncInfo) -> Iterable[FuncInfo]:
+        mod = info.module
+        for node in _walk_own_body(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            # Calls to concreteness guards are host-side by definition:
+            # they never trace, so they create no edge.
+            dotted = project.resolve_dotted(node.func, info.imports)
+            if dotted and dotted.rpartition(".")[2] in _CONCRETENESS_GUARDS:
+                continue
+            fi = self._resolve_func_ref(project, mod, info, node.func)
+            if fi is not None:
+                yield fi
+            # Function REFERENCES passed as arguments also trace: jax.grad
+            # /value_and_grad/vmap/lax.scan bodies, functools.partial, the
+            # kernel handed to pallas_call — any of them may run under the
+            # caller's trace.
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    fa = self._resolve_func_ref(project, mod, info, arg)
+                    if fa is not None:
+                        yield fa
+
+    # -- violation scan ---------------------------------------------------
+
+    def _scan(
+        self, project: Project, info: FuncInfo, root: str
+    ) -> Iterator[Finding]:
+        mod = info.module
+        via = "" if root == info.qualname else f" (reachable from jit root `{root}`)"
+        for node in self._walk_unguarded(info.node):
+            if isinstance(node, ast.Call):
+                dotted = project.resolve_dotted(node.func, info.imports)
+                if dotted in _HOST_SYNC_CALLS:
+                    yield Finding(
+                        rule=self.id,
+                        path=str(mod.path),
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"host-synchronizing call `{dotted}` in jit-"
+                            f"traced `{info.qualname}`{via} — under tracing "
+                            "this raises TracerArrayConversionError at best, "
+                            "or silently concretizes at trace time"
+                        ),
+                        suggestion=(
+                            "keep device values in jnp; if a concrete-input "
+                            "fast path is intended, guard it with "
+                            "fishnet_tpu.utils.tracing.is_concrete(x)"
+                        ),
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and not node.args
+                ):
+                    yield Finding(
+                        rule=self.id,
+                        path=str(mod.path),
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"`.item()` in jit-traced `{info.qualname}`{via} "
+                            "forces a device->host sync and fails under "
+                            "tracing"
+                        ),
+                        suggestion="keep the value as a traced array",
+                    )
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int", "bool")
+                    and node.args
+                    and not _is_static_expr(node.args[0])
+                    and _has_bare_value_name(node.args[0], info.imports)
+                ):
+                    yield Finding(
+                        rule=self.id,
+                        path=str(mod.path),
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"`{node.func.id}(...)` on a non-static value in "
+                            f"jit-traced `{info.qualname}`{via} concretizes "
+                            "the operand (TracerArrayConversionError under "
+                            "tracing)"
+                        ),
+                        suggestion=(
+                            "use jnp casts (x.astype(...)) or guard the host "
+                            "path with is_concrete(x)"
+                        ),
+                    )
+            elif isinstance(node, (ast.If, ast.While)):
+                if _test_branches_on_array(node.test):
+                    yield Finding(
+                        rule=self.id,
+                        path=str(mod.path),
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            "Python branch on array truthiness in jit-traced "
+                            f"`{info.qualname}`{via} — the trace bakes in one "
+                            "side of the branch"
+                        ),
+                        suggestion="use jnp.where / jax.lax.cond",
+                    )
+
+    def _walk_unguarded(self, func_node: ast.AST) -> Iterator[ast.AST]:
+        """Like _walk_own_body but skips `if` subtrees whose test is a
+        concreteness guard (host-only regions by construction)."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(func_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.If) and _is_concreteness_guard(node.test):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_concreteness_guard(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            attr = None
+            if isinstance(node.func, ast.Name):
+                attr = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+            if attr in _CONCRETENESS_GUARDS:
+                return True
+    return False
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Expressions that are static under tracing: literals, len(), and
+    anything derived from `.shape`/`.ndim`/`.dtype`/`.size` attributes."""
+    if isinstance(node, ast.Constant):
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+            "shape",
+            "ndim",
+            "dtype",
+            "size",
+        ):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) and sub.func.id == "len":
+            return True
+    return False
+
+
+def _has_bare_value_name(node: ast.AST, imports: Dict[str, str]) -> bool:
+    """True when the expression mentions a bare value name — a Name that
+    is neither the object of an attribute access (``cfg.l1`` is config,
+    not data), the callee of a call, nor a module alias.  This is what
+    separates ``bool(parent.any())`` (traced data) from
+    ``float(np.sqrt(1.0 / cfg.l1))`` (static config math)."""
+    skip: Set[int] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and isinstance(sub.value, ast.Name):
+            skip.add(id(sub.value))
+        elif isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+            skip.add(id(sub.func))
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Name)
+            and id(sub) not in skip
+            and sub.id not in imports
+            and sub.id not in ("len", "min", "max", "sum", "abs", "range")
+        ):
+            return True
+    return False
+
+
+def _test_branches_on_array(test: ast.AST) -> bool:
+    """Heuristic: a branch condition that calls .any()/.all() or bool()
+    on a non-static expression is branching on array truthiness."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("any", "all")
+                and not node.args
+            ):
+                return True
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "bool"
+                and node.args
+                and not _is_static_expr(node.args[0])
+            ):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# R3: deprecated / private JAX API
+# ---------------------------------------------------------------------------
+
+
+class DeprecatedJaxRule:
+    id = "R3"
+    name = "deprecated-jax"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules.values():
+            # Imports of private modules.
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ImportFrom):
+                    if node.module and node.module.startswith("jax._src"):
+                        yield self._finding(
+                            mod,
+                            node,
+                            f"import from private module `{node.module}`",
+                            "jax._src has no stability guarantees; import "
+                            "the public equivalent (jax., jax.extend., "
+                            "jax.experimental.)",
+                        )
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        if alias.name.startswith("jax._src"):
+                            yield self._finding(
+                                mod,
+                                node,
+                                f"import of private module `{alias.name}`",
+                                "use the public equivalent",
+                            )
+            # Attribute uses, resolved through the import tables.
+            scopes = [(None, mod.imports)] + [
+                (info, info.imports) for info in mod.functions.values()
+            ]
+            seen: set = set()
+            for info, imports in scopes:
+                body = (
+                    _walk_own_body(info.node)
+                    if info is not None
+                    else _walk_module_level(mod.tree)
+                )
+                for node in body:
+                    if not isinstance(node, (ast.Attribute, ast.Name)):
+                        continue
+                    dotted = project.resolve_dotted(node, imports)
+                    if dotted is None or id(node) in seen:
+                        continue
+                    if dotted == "jax.core.Tracer" or dotted.endswith(
+                        ".core.Tracer"
+                    ):
+                        seen.add(id(node))
+                        yield self._finding(
+                            mod,
+                            node,
+                            "use of deprecated `jax.core.Tracer`",
+                            "replace isinstance(x, jax.core.Tracer) checks "
+                            "with fishnet_tpu.utils.tracing.is_concrete(x) "
+                            "(backed by jax.core.is_concrete on jax 0.4.x)",
+                        )
+                    elif dotted.startswith("jax._src"):
+                        seen.add(id(node))
+                        yield self._finding(
+                            mod,
+                            node,
+                            f"use of private API `{dotted}`",
+                            "use the public equivalent",
+                        )
+
+    def _finding(self, mod: Module, node: ast.AST, msg: str, hint: str) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=str(mod.path),
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=msg,
+            suggestion=hint,
+        )
+
+
+def _walk_module_level(tree: ast.Module) -> Iterator[ast.AST]:
+    """Module statements without descending into function/class bodies
+    (those are covered per-function)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(tree))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# R4: cross-thread shared-state heuristics
+# ---------------------------------------------------------------------------
+
+#: Method calls that mutate their receiver.
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "add",
+    "clear",
+    "discard",
+    "extend",
+    "insert",
+    "pop",
+    "popleft",
+    "remove",
+    "setdefault",
+    "update",
+}
+
+
+class CrossThreadStateRule:
+    id = "R4"
+    name = "cross-thread-state"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules.values():
+            for cls, methods in mod.classes.items():
+                yield from self._check_class(project, mod, cls, methods)
+            yield from self._check_module_globals(project, mod)
+
+    # -- instance state ---------------------------------------------------
+
+    def _check_class(
+        self, project: Project, mod: Module, cls: str, methods: Dict[str, str]
+    ) -> Iterator[Finding]:
+        infos = {
+            name: mod.functions[q] for name, q in methods.items() if q in mod.functions
+        }
+        if not infos:
+            return
+        thread_roots = self._thread_roots(project, mod, infos)
+        if not thread_roots:
+            return
+        thread_closure = self._closure(infos, thread_roots)
+        other = {
+            name
+            for name in infos
+            if name not in thread_closure and name != "__init__"
+        }
+        # attr -> list of (method, line, guarded)
+        thread_mut: Dict[str, List[Tuple[str, int, bool]]] = {}
+        other_mut: Dict[str, List[Tuple[str, int, bool]]] = {}
+        for name, info in infos.items():
+            for attr, line, guarded in self._mutations(info):
+                if name in thread_closure:
+                    thread_mut.setdefault(attr, []).append((name, line, guarded))
+                if name in other:
+                    other_mut.setdefault(attr, []).append((name, line, guarded))
+        for attr in sorted(set(thread_mut) & set(other_mut)):
+            sites = thread_mut[attr] + other_mut[attr]
+            unguarded = [s for s in sites if not s[2]]
+            if not unguarded:
+                continue
+            name, line, _ = unguarded[0]
+            others = ", ".join(
+                sorted({f"{n}:{ln}" for n, ln, _ in sites if (n, ln) != (name, line)})
+            )
+            yield Finding(
+                rule=self.id,
+                path=str(mod.path),
+                line=line,
+                col=0,
+                message=(
+                    f"`self.{attr}` of `{cls}` is mutated from both a driver "
+                    f"thread and event-loop/async methods, and the mutation "
+                    f"in `{name}` holds no lock (other sites: {others})"
+                ),
+                suggestion=(
+                    "guard every mutation with the instance lock (`with "
+                    "self._lock:`) or hand the update through a queue"
+                ),
+            )
+
+    def _thread_roots(
+        self, project: Project, mod: Module, infos: Dict[str, FuncInfo]
+    ) -> Set[str]:
+        """Methods passed as Thread(target=self.X) / to_thread(self.X) /
+        run_in_executor(_, self.X) anywhere in the class."""
+        roots: Set[str] = set()
+        for info in infos.values():
+            for node in _walk_own_body(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = project.resolve_dotted(node.func, info.imports) or ""
+                candidates: List[ast.AST] = []
+                if dotted.endswith("Thread"):
+                    candidates += [
+                        kw.value for kw in node.keywords if kw.arg == "target"
+                    ]
+                elif dotted.endswith(("to_thread",)):
+                    candidates += node.args[:1]
+                elif isinstance(node.func, ast.Attribute) and node.func.attr == (
+                    "run_in_executor"
+                ):
+                    candidates += node.args[1:2]
+                for cand in candidates:
+                    if (
+                        isinstance(cand, ast.Attribute)
+                        and isinstance(cand.value, ast.Name)
+                        and cand.value.id == "self"
+                        and cand.attr in infos
+                    ):
+                        roots.add(cand.attr)
+        return roots
+
+    def _closure(self, infos: Dict[str, FuncInfo], roots: Set[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = list(roots)
+        while stack:
+            name = stack.pop()
+            if name in seen or name not in infos:
+                continue
+            seen.add(name)
+            for node in _walk_own_body(infos[name].node):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in infos
+                ):
+                    stack.append(node.func.attr)
+        return seen
+
+    def _mutations(self, info: FuncInfo) -> Iterator[Tuple[str, int, bool]]:
+        """(attr, line, guarded) for every `self.attr` mutation: plain /
+        aug / subscript assignment, and mutating method calls.  `guarded`
+        = lexically inside `with self.<something-lockish>:`."""
+        guarded_spans = self._lock_spans(info.node)
+
+        def is_guarded(line: int) -> bool:
+            return any(lo <= line <= hi for lo, hi in guarded_spans)
+
+        for node in _walk_own_body(info.node):
+            attr = None
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    attr = _self_attr_of_target(t)
+                    if attr:
+                        yield attr, node.lineno, is_guarded(node.lineno)
+                continue
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr in _MUTATORS:
+                    attr = _self_attr_of_target(node.func.value)
+                    if attr:
+                        yield attr, node.lineno, is_guarded(node.lineno)
+
+    def _lock_spans(self, func_node: ast.AST) -> List[Tuple[int, int]]:
+        spans: List[Tuple[int, int]] = []
+        for node in _walk_own_body(func_node):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                expr = item.context_expr
+                # `with self._lock:` or `with self._lock.acquire…` etc.
+                target = expr.func.value if isinstance(expr, ast.Call) else expr
+                attr = _self_attr_of_target(target)
+                if attr and ("lock" in attr.lower() or "mutex" in attr.lower()):
+                    end = getattr(node, "end_lineno", node.lineno)
+                    spans.append((node.lineno, end))
+                    break
+        return spans
+
+    # -- module globals ---------------------------------------------------
+
+    def _check_module_globals(self, project: Project, mod: Module) -> Iterator[Finding]:
+        """Module-level names rebound (via `global`) both from a function
+        that is a thread target and from an async function."""
+        thread_fns: Set[str] = set()
+        for info in mod.functions.values():
+            for node in _walk_own_body(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = project.resolve_dotted(node.func, info.imports) or ""
+                if dotted.endswith("Thread"):
+                    for kw in node.keywords:
+                        if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                            thread_fns.add(kw.value.id)
+
+        def global_writes(info: FuncInfo) -> Dict[str, int]:
+            declared: Set[str] = set()
+            for node in _walk_own_body(info.node):
+                if isinstance(node, ast.Global):
+                    declared.update(node.names)
+            writes: Dict[str, int] = {}
+            for node in _walk_own_body(info.node):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name) and t.id in declared:
+                            writes.setdefault(t.id, node.lineno)
+            return writes
+
+        thread_writes: Dict[str, Tuple[str, int]] = {}
+        async_writes: Dict[str, Tuple[str, int]] = {}
+        for info in mod.functions.values():
+            w = global_writes(info)
+            base = info.qualname.split(".")[0]
+            if base in thread_fns or info.qualname in thread_fns:
+                for name, line in w.items():
+                    thread_writes.setdefault(name, (info.qualname, line))
+            if info.is_async:
+                for name, line in w.items():
+                    async_writes.setdefault(name, (info.qualname, line))
+        for name in sorted(set(thread_writes) & set(async_writes)):
+            fn, line = thread_writes[name]
+            ofn, oline = async_writes[name]
+            yield Finding(
+                rule=self.id,
+                path=str(mod.path),
+                line=line,
+                col=0,
+                message=(
+                    f"module global `{name}` is rebound from thread target "
+                    f"`{fn}` and async function `{ofn}` (line {oline}) "
+                    "without synchronization"
+                ),
+                suggestion="protect with a lock or pass through a queue",
+            )
+
+
+def _self_attr_of_target(node: ast.AST) -> Optional[str]:
+    """`self.x`, `self.x[...]`, `self.x.y` → "x"; else None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        inner = node
+        while isinstance(inner.value, ast.Attribute):
+            inner = inner.value
+        if isinstance(inner.value, ast.Name) and inner.value.id == "self":
+            return inner.attr
+    return None
+
+
+ALL_RULES = [
+    AsyncBlockingRule(),
+    JitHostSyncRule(),
+    DeprecatedJaxRule(),
+    CrossThreadStateRule(),
+]

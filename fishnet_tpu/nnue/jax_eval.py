@@ -31,6 +31,16 @@ import numpy as np
 
 from fishnet_tpu.nnue import spec
 from fishnet_tpu.nnue.weights import NnueWeights
+from fishnet_tpu.utils.tracing import is_concrete
+
+#: Material poison for persistent anchor codes shipped WITHOUT the
+#: host-side material term.  Persistent entries' PSQT accumulators live
+#: host-side in the pool slot (not in the device anchor table), so the
+#: on-device PSQT path cannot resolve them; under tracing the misuse
+#: cannot raise, so the score is stamped with this instead — after
+#: FV_SCALE the affected evals come back around ±2^24 centipawns,
+#: unmistakably broken rather than plausibly wrong.
+_POISON_MATERIAL = 1 << 28
 
 Params = Dict[str, jax.Array]
 
@@ -131,7 +141,7 @@ def _evaluate_from_acc(
     ship a host-computed ``material`` (the anchor's PSQT lives host-side
     in the pool slot, not in the device table)."""
     if material is None:
-        if parent is not None and not isinstance(parent, jax.core.Tracer):
+        if parent is not None and is_concrete(parent):
             if bool((np.asarray(parent) <= -2).any()):
                 raise ValueError(
                     "persistent anchor codes require host-side material"
@@ -215,6 +225,16 @@ def _evaluate_from_acc(
             psqt, jnp.repeat(buckets[:, None, None], 2, axis=1), axis=2
         )[..., 0]
         material = _trunc_div(psqt_sel[:, 0] - psqt_sel[:, 1], 2)
+        if parent is not None:
+            # Structural twin of the eager guard above for TRACED parents:
+            # anchor-code entries (<= -2) have host-side PSQT state the
+            # device cannot see — poison their scores so the misuse is
+            # visible (see _POISON_MATERIAL).
+            material = jnp.where(
+                parent.astype(jnp.int32) <= -2,
+                jnp.int32(_POISON_MATERIAL),
+                material,
+            )
     else:
         material = material.astype(jnp.int32)
     positional = v + skip + _trunc_div(skip * 23, 127)

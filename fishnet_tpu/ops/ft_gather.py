@@ -40,8 +40,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from fishnet_tpu.nnue.spec import DELTA_SLOTS as _DELTA_SLOTS
+from fishnet_tpu.utils.tracing import is_concrete
 
 __all__ = ["ft_accumulate"]
+
+#: Accumulator poison for persistent anchor codes evaluated WITHOUT an
+#: anchor table.  Under tracing the misuse cannot raise (the values are
+#: not inspectable), so the structural guard stamps the affected
+#: entries' accumulators with this constant instead: every lane clips to
+#: zero downstream, collapsing the entry's eval to a per-bucket constant
+#: — loudly broken, unlike the plausibly-wrong unresolved partials the
+#: old code returned.  Direct consumers of the accumulator see -2^30.
+_POISON_ACC = -(1 << 30)
 
 
 def _xla_ft_accumulate(
@@ -81,7 +91,13 @@ def decode_parent(parent: jax.Array):
     persistent = stores & ((v & 2) != 0)
     in_batch = parent >= 0
     ref = jnp.where(in_batch, parent >> 1, 0)
-    swap = jnp.where(in_batch, parent & 1, v & 1).astype(bool)
+    # Plain fulls (-1) decode v = -1, whose low bit is set: mask the swap
+    # bit with (in_batch | stores) so fulls come back swap=0 — otherwise
+    # every full entry would grow a phantom perspective-swap flag that
+    # only the where-masks downstream happen to ignore today.
+    swap = jnp.where(
+        in_batch, parent & 1, jnp.where(stores, v & 1, 0)
+    ).astype(bool)
     aid = jnp.where(stores, v >> 2, 0)
     return in_batch, persistent, stores, ref, swap, aid
 
@@ -106,6 +122,14 @@ def _xla_resolve_parents(
             jnp.take(anchor_tab.astype(jnp.int32), aid, axis=0), swap
         )
         acc = jnp.where(persistent[:, None, None], acc + tab_acc - bias, acc)
+    else:
+        # Structural misuse guard (works under tracing, where the eager
+        # check in ft_accumulate cannot see the codes): persistent
+        # entries have no table to resolve against — poison them instead
+        # of returning unresolved partials that read as plausible evals.
+        acc = jnp.where(
+            persistent[:, None, None], jnp.int32(_POISON_ACC), acc
+        )
     ref_acc = _swap_persp(jnp.take(acc, ref, axis=0), swap)
     return jnp.where(in_batch[:, None, None], acc + ref_acc - bias, acc)
 
@@ -439,11 +463,14 @@ def ft_accumulate(
             jax.default_backend() == "tpu" and ft_w.shape[1] % 1024 == 0
         )
     if parent is not None:
-        # Persistent codes REQUIRE a table: without one the kernel would
-        # DMA out of bounds against the 1-row dummy and the XLA fallback
-        # would silently return unresolved partials. Traced parents
-        # can't be inspected; concrete ones (every direct caller) are.
-        if anchor_tab is None and not isinstance(parent, jax.core.Tracer):
+        # Persistent codes REQUIRE a table: without one neither backend
+        # can resolve them. Concrete parents (every direct caller) get
+        # the precise eager error below; traced parents are handled
+        # STRUCTURALLY — the XLA fallback poisons the affected entries'
+        # accumulators (_xla_resolve_parents) and the fused kernel strips
+        # the persistent flag (so no DMA is ever issued against the
+        # 1-row dummy table) and poisons the outputs likewise.
+        if anchor_tab is None and is_concrete(parent):
             import numpy as _np
 
             if bool((_np.asarray(parent) <= -2).any()):
@@ -457,15 +484,24 @@ def ft_accumulate(
             # bit 2: persistent (anchor-table row in anchor_ids).
             in_batch, persistent, _, _, swap, aid = decode_parent(parent)
             sparse_f = in_batch | persistent
+            tab_persistent = (
+                persistent if anchor_tab is not None
+                else jnp.zeros_like(persistent)
+            )
             flags = (
                 sparse_f.astype(jnp.int32)
                 | (swap.astype(jnp.int32) << 1)
-                | (persistent.astype(jnp.int32) << 2)
+                | (tab_persistent.astype(jnp.int32) << 2)
             )
-            return _pallas_ft_accumulate(
+            acc = _pallas_ft_accumulate(
                 ft_w, ft_b, indices, flags, aid, anchor_tab,
                 interpret=interpret, delta_base=delta_base, anchored=True,
             )
+            if anchor_tab is None:
+                acc = jnp.where(
+                    persistent[:, None, None], jnp.int32(_POISON_ACC), acc
+                )
+            return acc
         acc = _xla_ft_accumulate(ft_w, ft_b, indices, delta_base=delta_base)
         return _xla_resolve_parents(acc, ft_b, parent, anchor_tab)
     if use_pallas or interpret:

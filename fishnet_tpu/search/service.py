@@ -95,6 +95,10 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int,
     ]
+    # Returns entries consumed, or -1 when anchors are enabled and the
+    # provide is not the full batch (ABI 8; the full-provide contract is
+    # load-bearing for device anchor state — see cpp fc_pool_provide).
+    lib.fc_pool_provide.restype = ctypes.c_int
     lib.fc_pool_active.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.fc_pool_active.restype = ctypes.c_int
     lib.fc_pool_next_finished.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -800,7 +804,11 @@ class SearchService:
             # stop_all unsticks siblings BLOCKED inside a long native
             # step (scalar/HCE searches never suspend): the per-node
             # stop poll is the only signal such a thread can see.
-            self._stopping = True
+            # Under _lock like every other _stopping write (close(), the
+            # submit path reads it under the same lock) — the uniform
+            # locking discipline is what the R4 checker certifies.
+            with self._lock:
+                self._stopping = True
             if self._pool:
                 self._lib.fc_pool_stop_all(self._pool)
             for w in self._wakes:
@@ -924,11 +932,19 @@ class SearchService:
                 if g in inflight:
                     n_prev, arr = inflight.pop(g)
                     values = self._resolve_eval(n_prev, arr)
-                    lib.fc_pool_provide(
+                    rc = lib.fc_pool_provide(
                         self._pool, g,
                         values.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                         n_prev,
                     )
+                    if rc < 0:
+                        # The pool refused a partial provide (anchors
+                        # enabled): a service bug, not recoverable here —
+                        # fail loudly instead of corrupting anchor state.
+                        raise NativeCoreError(
+                            f"fc_pool_provide rejected {n_prev} values for "
+                            f"group {g}: full-provide contract violated"
+                        )
                 # Advance this group's fibers; fill its eval batch.
                 rows = ctypes.c_int32()
                 n = lib.fc_pool_step(

@@ -1,0 +1,47 @@
+"""Concreteness checks for code that is sometimes traced.
+
+``is_concrete(x)`` is the sanctioned guard for host-only fast paths
+inside functions that may run under ``jax.jit``: the static checker
+(fishnet_tpu.analysis R2) exempts ``if is_concrete(x):`` subtrees from
+the host-sync rules, because such a branch executes at trace time on the
+Python value and can never observe a traced array's contents.
+
+This replaces the deprecated ``isinstance(x, jax.core.Tracer)`` pattern
+(flagged by R3): ``jax.core.Tracer`` is slated for removal from the
+public namespace, while ``jax.core.is_concrete`` is the supported
+concreteness predicate on the pinned JAX line (0.4.3x).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["is_concrete"]
+
+
+def is_concrete(x) -> bool:
+    """True when ``x`` is host-inspectable NOW: a numpy array/scalar, a
+    Python number, or a committed ``jax.Array`` — anything but a tracer.
+
+    Cheap and import-light: jax is only consulted for values that could
+    actually be traced.
+    """
+    if x is None or isinstance(
+        x, (np.ndarray, np.generic, bool, int, float, complex, list, tuple)
+    ):
+        return True
+    import jax
+
+    checker = getattr(jax.core, "is_concrete", None)
+    if checker is not None:
+        try:
+            return bool(checker(x))
+        except TypeError:
+            return True  # not a jax value at all
+    # Fallback for jax versions without is_concrete: tracers refuse
+    # conversion to a host array.
+    try:
+        np.asarray(x)
+    except Exception:
+        return False
+    return True

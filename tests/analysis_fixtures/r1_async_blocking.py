@@ -1,0 +1,47 @@
+"""R1 fixture: blocking calls inside async bodies. Line numbers are
+asserted by tests/test_analysis.py — edit with care."""
+
+import asyncio
+import subprocess
+import time
+from time import sleep as zzz
+
+import requests
+
+
+async def bad_sleep():
+    time.sleep(1.0)  # VIOLATION line 13
+
+
+async def bad_alias_sleep():
+    zzz(0.5)  # VIOLATION line 17
+
+
+async def bad_subprocess():
+    subprocess.run(["true"])  # VIOLATION line 21
+
+
+async def bad_requests():
+    return requests.get("http://example.invalid")  # VIOLATION line 25
+
+
+async def bad_communicate(proc):
+    out, err = proc.communicate()  # VIOLATION line 29
+    return out
+
+
+async def fine():
+    await asyncio.sleep(1.0)
+    proc = await asyncio.create_subprocess_exec("true")
+    await proc.communicate()  # awaited: asyncio subprocess, fine
+    # Shipping the blocking callable off-loop is the sanctioned pattern:
+    await asyncio.to_thread(time.sleep, 0.1)
+
+    def helper():
+        time.sleep(1.0)  # sync nested def: runs in an executor, fine
+
+    return helper
+
+
+def sync_caller():
+    time.sleep(1.0)  # not async: fine

@@ -1,0 +1,58 @@
+"""R2 fixture: host sync reachable from jit roots. Line numbers are
+asserted by tests/test_analysis.py — edit with care."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fishnet_tpu.utils.tracing import is_concrete
+
+
+def leaf(x):
+    host = np.asarray(x)  # VIOLATION line 14 (reachable via jitted_root)
+    return jnp.sum(jnp.asarray(host))
+
+
+def middle(x):
+    if bool((x <= 0).any()):  # VIOLATION line 19 (branch on array truth)
+        return leaf(x)
+    return x * 2
+
+
+@jax.jit
+def jitted_root(x):
+    v = x.item()  # VIOLATION line 26 (.item in jit root)
+    return middle(x) + v
+
+
+def assigned_root(x):
+    return float(x) + 1.0  # VIOLATION line 31 (float() on traced value)
+
+
+assigned_jit = jax.jit(functools.partial(assigned_root))
+
+
+def guarded(x):
+    if is_concrete(x):
+        # Host-only fast path: exempt by the concreteness guard.
+        if bool((np.asarray(x) <= 0).any()):
+            raise ValueError("negative")
+    return x * 3
+
+
+guarded_jit = jax.jit(guarded)
+
+
+def never_traced(x):
+    # Not reachable from any jit root: host code may sync freely.
+    return np.asarray(x).item()
+
+
+def static_ok(x):
+    n = int(x.shape[0])  # static under tracing: exempt
+    return jnp.zeros((n,))
+
+
+static_jit = jax.jit(static_ok)

@@ -1,0 +1,16 @@
+"""R3 fixture: deprecated/private JAX API. Line numbers are asserted by
+tests/test_analysis.py — edit with care."""
+
+import jax
+import jax._src.xla_bridge as xb  # VIOLATION line 5
+from jax._src import core as private_core  # VIOLATION line 6
+
+
+def uses_tracer(x):
+    return isinstance(x, jax.core.Tracer)  # VIOLATION line 10
+
+
+def fine(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)

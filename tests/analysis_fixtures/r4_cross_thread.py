@@ -1,0 +1,54 @@
+"""R4 fixture: unsynchronized cross-thread instance/module state. Line
+numbers are asserted by tests/test_analysis.py — edit with care."""
+
+import threading
+
+_counter = 0
+
+
+def _thread_main():
+    global _counter
+    _counter += 1  # VIOLATION (global: thread side), line 11
+
+
+async def bump():
+    global _counter
+    _counter += 1  # (global: async side; thread-side line is reported)
+
+
+def start():
+    threading.Thread(target=_thread_main).start()
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._stopping = False
+        self._thread = threading.Thread(target=self._drive)
+
+    def _drive(self):
+        while True:
+            self._stopping = True  # VIOLATION line 32 (no lock, also async)
+            with self._lock:
+                self._items.pop()  # guarded: fine
+
+    async def submit(self, item):
+        with self._lock:
+            self._items.append(item)  # guarded: fine
+        self._stopping = False  # async-side mutation of the same flag
+
+
+class CleanService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self._queue.clear()
+
+    async def push(self, x):
+        with self._lock:
+            self._queue.append(x)

@@ -1,0 +1,17 @@
+"""Suppression fixture. Line numbers are asserted by
+tests/test_analysis.py — edit with care."""
+
+import time
+
+
+async def justified():
+    # One-shot startup script, loop idle by construction here:
+    time.sleep(0.1)  # fishnet: ignore[R1] -- startup path, loop not serving yet
+
+
+async def unjustified():
+    time.sleep(0.1)  # fishnet: ignore[R1]
+
+
+async def wrong_rule():
+    time.sleep(0.1)  # fishnet: ignore[R2] -- suppresses the wrong rule

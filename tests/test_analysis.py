@@ -1,0 +1,186 @@
+"""Tests for fishnet_tpu.analysis: each rule fires on its fixture at the
+right file:line, suppressions behave, the CLI round-trips exit codes —
+and the TREE IS CLEAN (the tier-1 gate that makes the checker binding:
+any reintroduced R1-R4 violation fails CI here, not in review).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+from fishnet_tpu.analysis.engine import check_paths
+from fishnet_tpu.analysis.rules import (
+    ALL_RULES,
+    AsyncBlockingRule,
+    CrossThreadStateRule,
+    DeprecatedJaxRule,
+    JitHostSyncRule,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures"
+PACKAGE = REPO / "fishnet_tpu"
+
+
+def _lines(findings, rule=None):
+    return sorted(
+        (f.rule, f.line) for f in findings if rule is None or f.rule == rule
+    )
+
+
+# -- R1 -------------------------------------------------------------------
+
+
+def test_r1_fires_on_known_lines():
+    findings = check_paths(
+        [FIXTURES / "r1_async_blocking.py"], [AsyncBlockingRule()]
+    )
+    assert _lines(findings) == [
+        ("R1", 13),  # time.sleep
+        ("R1", 17),  # aliased sleep
+        ("R1", 21),  # subprocess.run
+        ("R1", 25),  # requests.get
+        ("R1", 29),  # un-awaited .communicate()
+    ]
+
+
+def test_r1_exempts_executor_and_nested_sync_defs():
+    findings = check_paths(
+        [FIXTURES / "r1_async_blocking.py"], [AsyncBlockingRule()]
+    )
+    flagged = {f.line for f in findings}
+    # Nothing in fine() / sync_caller() (lines >= 33) may fire.
+    assert all(line < 33 for line in flagged)
+
+
+# -- R2 -------------------------------------------------------------------
+
+
+def test_r2_fires_on_known_lines():
+    findings = check_paths(
+        [FIXTURES / "r2_jit_host_sync.py"], [JitHostSyncRule()]
+    )
+    assert _lines(findings) == [
+        ("R2", 14),  # np.asarray in transitively-reached leaf
+        ("R2", 19),  # branch on array truthiness (If)
+        ("R2", 19),  # bool() concretization (same line)
+        ("R2", 26),  # .item() in the decorated root
+        ("R2", 31),  # float() in a jax.jit(partial(...))-assigned root
+    ]
+
+
+def test_r2_reports_the_jit_root_for_transitive_hits():
+    findings = check_paths(
+        [FIXTURES / "r2_jit_host_sync.py"], [JitHostSyncRule()]
+    )
+    by_line = {f.line: f for f in findings}
+    assert "jitted_root" in by_line[14].message  # leaf blames its root
+
+
+def test_r2_exempts_guards_statics_and_host_code():
+    findings = check_paths(
+        [FIXTURES / "r2_jit_host_sync.py"], [JitHostSyncRule()]
+    )
+    flagged = {f.line for f in findings}
+    # guarded() (is_concrete region), never_traced(), static_ok() clean.
+    assert all(line <= 31 for line in flagged)
+
+
+# -- R3 -------------------------------------------------------------------
+
+
+def test_r3_fires_on_known_lines():
+    findings = check_paths(
+        [FIXTURES / "r3_deprecated_jax.py"], [DeprecatedJaxRule()]
+    )
+    assert _lines(findings) == [
+        ("R3", 5),  # import jax._src.xla_bridge
+        ("R3", 6),  # from jax._src import core
+        ("R3", 10),  # jax.core.Tracer
+    ]
+    tracer = [f for f in findings if f.line == 10][0]
+    assert "is_concrete" in (tracer.suggestion or "")
+
+
+# -- R4 -------------------------------------------------------------------
+
+
+def test_r4_fires_on_known_lines():
+    findings = check_paths(
+        [FIXTURES / "r4_cross_thread.py"], [CrossThreadStateRule()]
+    )
+    assert _lines(findings) == [
+        ("R4", 11),  # module global from thread + async
+        ("R4", 32),  # self._stopping unguarded in driver thread
+    ]
+
+
+def test_r4_lock_guarded_class_is_clean():
+    findings = check_paths(
+        [FIXTURES / "r4_cross_thread.py"], [CrossThreadStateRule()]
+    )
+    assert not any("CleanService" in f.message for f in findings)
+    assert not any("_items" in f.message for f in findings)
+    assert not any("_queue" in f.message for f in findings)
+
+
+# -- suppressions ---------------------------------------------------------
+
+
+def test_suppressions():
+    findings = check_paths([FIXTURES / "suppressions.py"], [AsyncBlockingRule()])
+    assert _lines(findings) == [
+        ("R1", 17),  # wrong-rule suppression does not apply
+        ("SUP", 13),  # suppression without justification is itself flagged
+    ]
+
+
+# -- the repo gate --------------------------------------------------------
+
+
+def test_fishnet_tpu_tree_is_clean():
+    """THE tier-1 invariant: the package tree passes its own checker.
+
+    If this fails, either fix the flagged code or add a justified
+    inline suppression (`# fishnet: ignore[Rn] -- why`) — see
+    doc/static-analysis.md.
+    """
+    findings = check_paths([PACKAGE], ALL_RULES)
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+# -- CLI ------------------------------------------------------------------
+
+
+def test_cli_exit_codes():
+    clean = subprocess.run(
+        [sys.executable, "-m", "fishnet_tpu.analysis", str(PACKAGE), "-q"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    dirty = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "fishnet_tpu.analysis",
+            str(FIXTURES / "r1_async_blocking.py"),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert dirty.returncode == 1
+    assert "R1" in dirty.stdout
+    rules = subprocess.run(
+        [sys.executable, "-m", "fishnet_tpu.analysis", "--list-rules"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert rules.returncode == 0
+    for rid in ("R1", "R2", "R3", "R4"):
+        assert rid in rules.stdout

@@ -4,7 +4,12 @@ invariants its runtime validation silently relies on)."""
 
 import json
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# Property tests need the optional `hypothesis` package; skip the module
+# (not a collection error) where it is not installed.
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from fishnet_tpu.chess.board import Board
 from fishnet_tpu.ipc import Position, PositionResponse

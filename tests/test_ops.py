@@ -415,3 +415,86 @@ def test_evaluate_packed_anchored_offsets_and_store():
     assert not np.array_equal(new_tab[3], tab[3])
     assert np.array_equal(new_tab[1], tab[1])
     assert np.array_equal(new_tab[2], tab[2])
+
+
+def test_decode_parent_masks_swap_for_plain_fulls():
+    """Plain fulls (-1) decode v=-1 whose low bit is set; the decoded
+    swap must be masked with (in_batch | stores) so fulls come back
+    swap=0 — any future consumer of the decoded mask relies on it."""
+    from fishnet_tpu.ops.ft_gather import decode_parent
+
+    parent = jnp.asarray(
+        np.array(
+            [
+                -1,  # plain full
+                5,  # in-batch delta ref 2, swap=1
+                4,  # in-batch delta ref 2, swap=0
+                -(2 + (3 << 2) + 2 + 1),  # persistent, row 3, swap=1
+                -(2 + (7 << 2)),  # full anchor reseed row 7, swap=0
+            ],
+            np.int32,
+        )
+    )
+    in_batch, persistent, stores, ref, swap, aid = decode_parent(parent)
+    assert np.asarray(swap).tolist() == [False, True, False, True, False]
+    assert np.asarray(in_batch).tolist() == [False, True, True, False, False]
+    assert np.asarray(persistent).tolist() == [False, False, False, True, False]
+    assert np.asarray(aid).tolist() == [0, 0, 0, 3, 7]
+
+
+def test_persistent_codes_without_table_raise_eagerly():
+    from fishnet_tpu.nnue import spec as _spec
+
+    ft_w, ft_b, idx = _fixture(batch=3)
+    parent = np.array([-1, -4, -1], np.int32)  # -4: persistent delta code
+    with pytest.raises(ValueError, match="anchor_tab"):
+        ft_accumulate(
+            ft_w, ft_b, idx, use_pallas=False,
+            delta_base=_spec.DELTA_BASE, parent=jnp.asarray(parent),
+        )
+
+
+def test_persistent_codes_without_table_poison_under_trace():
+    """Traced misuse cannot raise: the structural guard must poison the
+    affected entries (loudly constant) instead of returning plausible
+    unresolved partials — ADVICE r5 / ISSUE satellite."""
+    import jax
+
+    from fishnet_tpu.nnue import spec as _spec
+    from fishnet_tpu.ops.ft_gather import _POISON_ACC
+
+    ft_w, ft_b, idx = _fixture(batch=3)
+    parent = jnp.asarray(np.array([-1, -4, -1], np.int32))
+
+    @jax.jit
+    def run(w, b, i, p):
+        return ft_accumulate(
+            w, b, i, use_pallas=False, delta_base=_spec.DELTA_BASE, parent=p
+        )
+
+    acc = np.asarray(run(ft_w, ft_b, idx, parent))
+    assert (acc[1] == _POISON_ACC).all()
+    assert (acc[0] != _POISON_ACC).any() and (acc[2] != _POISON_ACC).any()
+
+
+def test_persistent_codes_without_material_poison_scores_under_trace():
+    import jax
+
+    from fishnet_tpu.nnue import spec as _spec
+    from fishnet_tpu.nnue.jax_eval import evaluate_batch, params_from_weights
+    from fishnet_tpu.nnue.weights import NnueWeights
+
+    params = params_from_weights(NnueWeights.random(seed=5))
+    feats = jnp.asarray(
+        np.full((3, 2, _spec.MAX_ACTIVE_FEATURES), _spec.NUM_FEATURES, np.uint16)
+    )
+    buckets = jnp.zeros((3,), jnp.int32)
+    parent = jnp.asarray(np.array([-1, -4, -1], np.int32))
+
+    @jax.jit
+    def run(p, f, b, par):
+        return evaluate_batch(p, f, b, par)
+
+    vals = np.asarray(run(params, feats, buckets, parent))
+    assert abs(int(vals[1])) > 10**6  # ~2^24 cp: unmistakably poisoned
+    assert abs(int(vals[0])) < 10**6 and abs(int(vals[2])) < 10**6

@@ -1,0 +1,60 @@
+"""Sanitizer smoke (slow, not tier-1): build the ASan+UBSan pool stress
+driver and run it — including the persistent-anchor provide-guard unit
+phase — failing on any sanitizer report.
+
+Tier-1 proves the pool's results are right; this job is the only gate
+that can see a data race or heap error that happens to produce the right
+move.  TSan is covered by `tools/sanitize.sh tsan` / CI, not here: its
+runtime roughly 10x's the stress wall clock.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def stress_net(tmp_path_factory) -> Path:
+    from fishnet_tpu.nnue.weights import NnueWeights
+
+    path = tmp_path_factory.mktemp("san") / "stress.nnue"
+    NnueWeights.random(seed=3).save(path)
+    return path
+
+
+@pytest.mark.parametrize("sanitizer", ["asan", "ubsan"])
+def test_pool_stress_clean_under_sanitizer(sanitizer, stress_net):
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    build = subprocess.run(
+        ["make", "-C", str(REPO / "cpp"), sanitizer],
+        capture_output=True,
+        text=True,
+    )
+    assert build.returncode == 0, build.stderr[-2000:]
+    binary = REPO / "cpp" / "build" / sanitizer / "pool_stress_main"
+    assert binary.exists()
+    env = dict(
+        os.environ,
+        ASAN_OPTIONS="halt_on_error=1:detect_leaks=0",
+        UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1",
+    )
+    run = subprocess.run(
+        [str(binary), str(stress_net), "12", "2"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=600,
+    )
+    assert run.returncode == 0, (run.stdout + run.stderr)[-4000:]
+    # The guard phase must actually have executed (needs the net).
+    assert "provide-guard: full-provide contract enforced" in run.stdout

@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+# Sanitizer driver: build the instrumented pool stress binary for each
+# requested sanitizer and run it; any sanitizer report (or guard-case
+# failure) fails the script.
+#
+#   tools/sanitize.sh                 # asan ubsan tsan, default workload
+#   tools/sanitize.sh asan ubsan      # subset (CI smoke runs exactly this)
+#   SANITIZE_NET=path/to/net.nnue tools/sanitize.sh
+#
+# A net is what arms the NNUE half of the stress traffic AND the
+# persistent-anchor provide-guard unit phase; without one (and without a
+# Python able to synthesize one) the run covers HCE/variant traffic
+# only, and says so.
+#
+# See doc/static-analysis.md for what each sanitizer is expected to
+# catch in this codebase.
+
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+SANITIZERS=("$@")
+if [ ${#SANITIZERS[@]} -eq 0 ]; then
+    SANITIZERS=(asan ubsan tsan)
+fi
+
+SEARCHES="${SANITIZE_SEARCHES:-24}"
+THREADS="${SANITIZE_THREADS:-4}"
+
+NET="${SANITIZE_NET:-}"
+if [ -z "$NET" ]; then
+    NET="$(mktemp -t sanitize-net-XXXXXX.nnue)"
+    trap 'rm -f "$NET"' EXIT
+    if python - "$NET" <<'EOF'
+import sys
+from fishnet_tpu.nnue.weights import NnueWeights
+NnueWeights.random(seed=3).save(sys.argv[1])
+EOF
+    then
+        echo "sanitize: synthesized test net at $NET"
+    else
+        echo "sanitize: WARNING - no net available; NNUE traffic and the"
+        echo "sanitize: provide-guard phase will be SKIPPED (HCE only)."
+        NET=""
+    fi
+fi
+
+fail=0
+for san in "${SANITIZERS[@]}"; do
+    case "$san" in
+        asan|ubsan|tsan) ;;
+        *) echo "sanitize: unknown sanitizer '$san' (want asan|ubsan|tsan)"; exit 2 ;;
+    esac
+    echo "==> make -C cpp $san"
+    make -C cpp "$san"
+    bin="cpp/build/$san/pool_stress_main"
+    echo "==> $bin ${NET:-<no net>} $SEARCHES $THREADS"
+    # halt_on_error: the binary's exit code IS the gate; leak detection
+    # off for asan (the stress driver tears the pool down, but JAX-side
+    # leaks are not this harness's business and ucontext stacks confuse
+    # the leak scanner).
+    if ! ASAN_OPTIONS="halt_on_error=1:detect_leaks=0" \
+         UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1" \
+         TSAN_OPTIONS="halt_on_error=1" \
+         "$bin" "$NET" "$SEARCHES" "$THREADS"; then
+        echo "sanitize: $san FAILED"
+        fail=1
+    fi
+done
+
+if [ "$fail" -ne 0 ]; then
+    echo "sanitize: FAILURES (see reports above)"
+    exit 1
+fi
+echo "sanitize: all clean (${SANITIZERS[*]})"
