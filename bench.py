@@ -172,8 +172,9 @@ def bench_device_evaluator(params) -> dict:
     ):
         indices, parent = make(size)
         buckets = rng.integers(0, 8, size, dtype=np.int32)
-        # Production wire shape: the native pool ships the PSQT material
-        # term precomputed host-side; the device never gathers PSQT.
+        # Host-material wire shape (kept so this tier's series stays
+        # comparable across rounds); the ABI 9 production wire ships no
+        # material and the realized-mix tier below prices THAT path.
         material = rng.integers(-2000, 2000, size, dtype=np.int32)
         d_idx = jax.device_put(jnp.asarray(indices))
         d_buckets = jax.device_put(jnp.asarray(buckets))
@@ -237,16 +238,21 @@ def bench_realized_mix(params, captured: dict) -> dict:
     indices = np.ascontiguousarray(captured["feats"].astype(np.int32))
     parent = captured["parents"]
     buckets = captured["buckets"]
+    # ABI 9 device-PSQT wire: no material column was captured — the
+    # replay prices the fused/XLA device PSQT path (anchor-PSQT table
+    # threaded and scattered like production) instead of the host term.
     material = captured["material"]
+    device_psqt = material is None
     size = len(buckets)
     # Replay with a live anchor table so the persistent-delta entries'
     # row DMAs and the store scatter are priced like production.
     tab_rows = int(anchor_ids_np(parent).max()) + 1
 
     @jax.jit
-    def eval_loop(params, indices, buckets, parent, material, tab, rounds):
+    def eval_loop(params, indices, buckets, parent, material, tab, ptab,
+                  rounds):
         def body(i, carry):
-            acc_sum, tab = carry
+            acc_sum, tab, ptab = carry
             pert = (i * 97) % spec.NUM_FEATURES
             is_plain = indices < spec.NUM_FEATURES
             is_delta = (indices >= spec.DELTA_BASE) & (
@@ -260,30 +266,47 @@ def bench_realized_mix(params, captured: dict) -> dict:
                 idx,
             )
             b = (buckets + i) % spec.NUM_PSQT_BUCKETS
-            acc = ft_accumulate(
-                params["ft_w"], params["ft_b"], idx,
-                delta_base=spec.DELTA_BASE, parent=parent, anchor_tab=tab,
+            psqt = None
+            if device_psqt:
+                acc, psqt = ft_accumulate(
+                    params["ft_w"], params["ft_b"], idx,
+                    delta_base=spec.DELTA_BASE, parent=parent,
+                    anchor_tab=tab, ft_psqt=params["ft_psqt"],
+                    psqt_tab=ptab,
+                )
+            else:
+                acc = ft_accumulate(
+                    params["ft_w"], params["ft_b"], idx,
+                    delta_base=spec.DELTA_BASE, parent=parent, anchor_tab=tab,
+                )
+            vals = _evaluate_from_acc(
+                params, acc, idx, b, parent, material, psqt=psqt
             )
-            vals = _evaluate_from_acc(params, acc, idx, b, parent, material)
             _, _, stores, _, _, aid = decode_parent(parent)
             row = jnp.where(stores, aid, tab.shape[0])
             tab = tab.at[row].set(
                 acc.reshape(parent.shape[0], 2, -1), mode="drop"
             )
-            return acc_sum + vals.sum(), tab
+            if psqt is not None:
+                ptab = ptab.at[row].set(psqt, mode="drop")
+            return acc_sum + vals.sum(), tab, ptab
 
         return jax.lax.fori_loop(
-            0, rounds, body, (jnp.int32(0), tab)
+            0, rounds, body, (jnp.int32(0), tab, ptab)
         )[0]
 
     tab0 = jnp.zeros((tab_rows, 2, spec.L1), jnp.int32)
-    d = [jax.device_put(jnp.asarray(x)) for x in (indices, buckets, parent, material)]
+    ptab0 = jnp.zeros((tab_rows, 2, spec.NUM_PSQT_BUCKETS), jnp.int32)
+    d = [jax.device_put(jnp.asarray(x)) for x in (indices, buckets, parent)]
+    d_mat = (
+        None if material is None else jax.device_put(jnp.asarray(material))
+    )
     r1, r2 = 2, 2 + 64 * max(1, 16384 // size)
-    int(eval_loop(params, d[0], d[1], d[2], d[3], tab0, r1))  # compile + warm
+    int(eval_loop(params, d[0], d[1], d[2], d_mat, tab0, ptab0, r1))  # warm
 
     def timed(rounds: int) -> float:
         t0 = time.perf_counter()
-        int(eval_loop(params, d[0], d[1], d[2], d[3], tab0, rounds))
+        int(eval_loop(params, d[0], d[1], d[2], d_mat, tab0, ptab0, rounds))
         return time.perf_counter() - t0
 
     t_small = sorted(timed(r1) for _ in range(3))[1]
@@ -291,6 +314,7 @@ def bench_realized_mix(params, captured: dict) -> dict:
     per_eval_s = (t_big - t_small) / (r2 - r1)
     out = {
         "batch": size,
+        "psqt": "device" if device_psqt else "host-material",
         "delta_share": round(float(is_delta_np(parent).mean()), 4),
         "anchor_share": round(
             float((is_delta_np(parent) & (parent <= -2)).mean()), 4
@@ -531,9 +555,17 @@ def traffic_report(counters: dict, total_nodes: int) -> dict:
         "tt_eval_hits": counters["tt_eval_hits"],
         "prefetch_budget": counters["prefetch_budget"],
         # Host->device payload per step under the compact wire format
-        # (packed delta rows ship 32 bytes/entry instead of 128).
+        # (packed delta rows ship 32 bytes/entry instead of 128), split
+        # feature-side vs the material column so the ABI 9 saving (the
+        # device-PSQT wire ships NO material) is visible in the series.
         "wire_mb_per_step": round(
             counters.get("wire_bytes", 0) / steps / 1e6, 3
+        ),
+        "wire_feature_mb_per_step": round(
+            counters.get("wire_feature_bytes", 0) / steps / 1e6, 3
+        ),
+        "wire_material_mb_per_step": round(
+            counters.get("wire_material_bytes", 0) / steps / 1e6, 3
         ),
         # Fraction of shipped eval slots that went out as incremental
         # deltas (8 row-DMAs instead of ~64 on the device).
@@ -837,7 +869,7 @@ def main() -> None:
         orig_eval = service._eval_fn
 
         def capturing_eval(params, packed, buckets, parents, material,
-                           anchor_tab, n_rows):
+                           anchor_tab, n_rows, psqt_tab):
             # Key the capture on REAL entries (non-sentinel fulls +
             # deltas), not the padded bucket length: every large step
             # ships the same bucket size, and keying on it let drain-
@@ -860,11 +892,15 @@ def main() -> None:
                         np.asarray(packed), off, p
                     ).astype(np.int32),
                     buckets=np.array(buckets),
-                    parents=np.array(parents), material=np.array(material),
+                    parents=np.array(parents),
+                    # ABI 9 device-PSQT wire ships NO material column;
+                    # the realized-mix replay then prices the device
+                    # PSQT path instead.
+                    material=None if material is None else np.array(material),
                     packed_rows=len(packed), real_n=real_n,
                 )
             return orig_eval(params, packed, buckets, parents, material,
-                             anchor_tab, n_rows)
+                             anchor_tab, n_rows, psqt_tab)
 
         service._eval_fn = capturing_eval
         asyncio.run(run_searches(service, jobs[:8], 500))  # touch the pipeline once
@@ -950,6 +986,10 @@ def main() -> None:
             wt["steps_per_s"] = round(window["steps"] / window_seconds, 2)
             wt["rtt_ms_256_before"] = rtt_before
             wt["budget_at_start"] = before.get("prefetch_budget", 0)
+            # Which executor served PSQT this window: "fused" (Pallas
+            # kernel), "xla" (bit-identical fallback), or
+            # "host-material" (legacy wire, material column shipped).
+            wt["psqt_path"] = service.psqt_path
             window_traffics.append(wt)
             window_nps.append(window["nodes"] / window_seconds)
             log(
@@ -1008,6 +1048,7 @@ def main() -> None:
                 "value": round(nps),
                 "unit": "nodes/s",
                 "vs_baseline": round(nps / REFERENCE_BASELINE_NPS, 4),
+                "psqt_path": service.psqt_path,
                 "transport": transport,
                 "device": device,
                 "host": host,

@@ -41,7 +41,10 @@ extern "C" {
 // through shifted arguments).
 // 8: fc_pool_provide returns int (entries consumed / -1 on a
 //    full-provide contract violation with anchors enabled).
-int fc_abi_version() { return 8; }
+// 9: fc_pool_step's out_material may be nullptr — the material column
+//    is optional on the wire (device-resident PSQT path; kept for the
+//    CPU/XLA host-material fallback and tests).
+int fc_abi_version() { return 9; }
 
 int fc_init() {
   init_bitboards();

@@ -649,7 +649,12 @@ EmitResult emit_block(SearchPool* pool,
     }
     out_buckets[idx] = slot.buckets[j];
     out_slots[idx] = i;
-    out_material[idx] = slot.material[j];
+    // ABI 9: the material column is optional — callers running the
+    // device-resident PSQT path (fused kernel / XLA twin plus the
+    // anchor-PSQT table) pass nullptr and the wire drops 4 bytes/entry.
+    // The host-side walk still runs (slot.material feeds the stale-
+    // batch repair and the CPU/XLA fallback wire).
+    if (out_material) out_material[idx] = slot.material[j];
     // WIRE parent encoding: -1 plain full; >= 0 in-batch delta
     // (ref << 1 | swap, rebased from block entries to batch positions —
     // the whole block ships in this batch, so the reference resolves
@@ -698,8 +703,10 @@ EmitResult emit_block(SearchPool* pool,
 //
 // out_packed must hold 4*capacity rows of uint16[2][8] (worst case:
 // all entries full); out_offsets/out_buckets/out_slots/out_parent/
-// out_material hold `capacity` int32 each. *out_rows receives the
-// number of packed rows written.
+// out_material hold `capacity` int32 each. out_material may be nullptr
+// (ABI 9): the material column is then skipped — for evaluators that
+// resolve PSQT entirely on device (fused kernel + anchor-PSQT table).
+// *out_rows receives the number of packed rows written.
 int fc_pool_step(SearchPool* pool, int group, uint16_t* out_packed,
                  int32_t* out_offsets, int32_t* out_buckets,
                  int32_t* out_slots, int32_t* out_parent,

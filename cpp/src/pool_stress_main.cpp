@@ -26,6 +26,7 @@
 #include <thread>
 #include <vector>
 
+#include "nnue.h"
 #include "types.h"
 
 // The pool's C surface (defined in pool.cpp; no public header by design
@@ -170,6 +171,159 @@ int provide_guard_check(const char* net_path) {
   return failures ? 1 : 0;
 }
 
+// Unit phase: anchors + PSQT wire cross-check (ABI 9). Drives real
+// batched-NNUE search traffic with persistent anchors enabled and, for
+// every emitted batch, rebuilds each entry's resolved [2][8] PSQT
+// accumulator FROM THE WIRE ALONE — packed feature rows (removal
+// encodings decoded via NNUE_DELTA_BASE), parent codes (in-batch refs,
+// persistent anchor loads with perspective swap), and a driver-side
+// anchor-PSQT table mirroring the device's — then checks the pool's
+// host-computed material column against the bucket-selected difference.
+// This is the same reconstruction the fused TPU kernel performs, so a
+// pass proves host material and device PSQT are interchangeable after
+// arbitrary delta chains. Every other step passes out_material=nullptr,
+// covering the ABI 9 optional-material wire layout under the
+// sanitizers.
+int anchors_psqt_check(const char* net_path) {
+  using fc::NNUE_DELTA_BASE;
+  using fc::NNUE_FEATURES;
+  using fc::NNUE_PSQT_BUCKETS;
+
+  fc::NnueNet net;
+  std::string err = net.load(net_path);
+  if (!err.empty()) {
+    std::fprintf(stderr, "anchors-psqt: net load failed: %s\n", err.c_str());
+    return 1;
+  }
+  constexpr int SLOTS = 8;
+  SearchPool* pool = fc_pool_new(SLOTS, /*tt_bytes=*/1 << 20, net_path,
+                                 /*n_groups=*/1);
+  if (!pool) {
+    std::fprintf(stderr, "anchors-psqt: fc_pool_new failed\n");
+    return 1;
+  }
+  fc_pool_set_anchors(pool, 1);
+  const char* fens[] = {STARTPOS, MIDGAME, ENDGAME};
+  for (int i = 0; i < 6; i++) {
+    if (fc_pool_submit(pool, 0, fens[i % 3], "", /*nodes=*/8000,
+                       /*depth=*/7, /*multipv=*/1, /*skill=*/20,
+                       /*use_scalar=*/0, fc::VR_STANDARD) < 0) {
+      std::fprintf(stderr, "anchors-psqt: submit failed\n");
+      fc_pool_free(pool);
+      return 1;
+    }
+  }
+
+  std::vector<uint16_t> packed((4 * CAPACITY + 4) * 2 * 8);
+  std::vector<int32_t> offsets(CAPACITY), buckets(CAPACITY), slots(CAPACITY),
+      parent(CAPACITY), material(CAPACITY), values(CAPACITY, 0);
+  // Driver-side twin of the device anchor-PSQT table: one [2][8]
+  // accumulator per pool slot (n_groups=1, so aid == slot index).
+  int64_t table[SLOTS][2][NNUE_PSQT_BUCKETS] = {};
+  int64_t resolved[CAPACITY][2][NNUE_PSQT_BUCKETS];
+  int32_t rows = 0;
+  int failures = 0;
+  long verified = 0, persistent_loads = 0;
+
+  auto add_row = [&](int64_t (*acc)[NNUE_PSQT_BUCKETS], int p, uint16_t f) {
+    if (f == NNUE_FEATURES || f == NNUE_DELTA_BASE + NNUE_FEATURES) return;
+    int sign = 1;
+    int fi = int(f);
+    if (fi >= NNUE_DELTA_BASE) {
+      sign = -1;
+      fi -= NNUE_DELTA_BASE;
+    }
+    const int32_t* prow = &net.ft_psqt[size_t(fi) * NNUE_PSQT_BUCKETS];
+    for (int b = 0; b < NNUE_PSQT_BUCKETS; b++) acc[p][b] += sign * prow[b];
+  };
+
+  for (int iter = 0; iter < 4000 && fc_pool_active(pool, 0) > 0; iter++) {
+    // Every other step ships the ABI 9 wire WITHOUT the material
+    // column (out_material=nullptr): the layout the device-PSQT hot
+    // path uses; the sanitizers watch the pool skip the column.
+    bool with_material = (iter % 2) == 0;
+    int n = fc_pool_step(pool, 0, packed.data(), offsets.data(),
+                         buckets.data(), slots.data(), parent.data(),
+                         with_material ? material.data() : nullptr, CAPACITY,
+                         0, &rows);
+    for (int idx = 0; idx < n; idx++) {
+      int32_t code = parent[idx];
+      int32_t v = -code - 2;
+      bool is_pers = code <= -2 && (v & 2) != 0;
+      bool is_delta = code >= 0 || is_pers;
+      int swap = 0;
+      int64_t base[2][NNUE_PSQT_BUCKETS] = {};
+      if (code >= 0) {
+        swap = code & 1;
+        std::memcpy(base, resolved[code >> 1], sizeof(base));
+      } else if (is_pers) {
+        swap = v & 1;
+        std::memcpy(base, table[(v >> 2) % SLOTS], sizeof(base));
+        persistent_loads++;
+      }
+      int64_t acc[2][NNUE_PSQT_BUCKETS] = {};
+      for (int p = 0; p < 2; p++)
+        for (int b = 0; b < NNUE_PSQT_BUCKETS; b++)
+          acc[p][b] = is_delta ? base[swap ? 1 - p : p][b] : 0;
+      int n_rows = is_delta ? 1 : 4;
+      for (int r = 0; r < n_rows; r++)
+        for (int p = 0; p < 2; p++)
+          for (int k = 0; k < 8; k++)
+            add_row(acc, p,
+                    packed[((size_t(offsets[idx]) + r) * 2 + p) * 8 + k]);
+      std::memcpy(resolved[idx], acc, sizeof(acc));
+      if (code <= -2)  // store codes refresh the slot's table row
+        std::memcpy(table[(v >> 2) % SLOTS], acc, sizeof(acc));
+      int64_t d = acc[0][buckets[idx]] - acc[1][buckets[idx]];
+      int32_t expect = int32_t(d / 2);  // C truncation, as fill_full
+      if (with_material && material[idx] != expect) {
+        if (failures++ < 8)
+          std::fprintf(stderr,
+                       "anchors-psqt: entry %d (code %d) host material %d "
+                       "!= wire reconstruction %d\n",
+                       idx, code, material[idx], int(expect));
+      }
+      verified++;
+      values[idx] = expect;  // provide a material-shaped score
+    }
+    if (n > 0 && fc_pool_provide(pool, 0, values.data(), n) != n) {
+      std::fprintf(stderr, "anchors-psqt: full provide rejected\n");
+      failures++;
+      break;
+    }
+    int slot;
+    while ((slot = fc_pool_next_finished(pool, 0)) >= 0)
+      fc_pool_release(pool, slot);
+  }
+  if (verified == 0) {
+    std::fprintf(stderr, "anchors-psqt: no eval entries were emitted\n");
+    failures++;
+  }
+  if (persistent_loads == 0) {
+    std::fprintf(stderr,
+                 "anchors-psqt: no persistent anchor-load entries seen — "
+                 "the phase never exercised the device-table path\n");
+    failures++;
+  }
+  fc_pool_abort_all(pool);
+  while (fc_pool_active(pool, 0) > 0) {
+    int n = fc_pool_step(pool, 0, packed.data(), offsets.data(),
+                         buckets.data(), slots.data(), parent.data(),
+                         material.data(), CAPACITY, 0, &rows);
+    if (n > 0 && fc_pool_provide(pool, 0, values.data(), n) != n) break;
+    int slot;
+    while ((slot = fc_pool_next_finished(pool, 0)) >= 0)
+      fc_pool_release(pool, slot);
+  }
+  fc_pool_free(pool);
+  if (failures == 0)
+    std::printf("anchors-psqt: %ld entries reconstructed from the wire "
+                "(%ld persistent loads), host material exact; nullptr "
+                "material column exercised\n",
+                verified, persistent_loads);
+  return failures ? 1 : 0;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -178,9 +332,11 @@ int main(int argc, char** argv) {
   const int n_threads = argc > 3 ? std::atoi(argv[3]) : 4;
   const bool have_net = net_path[0] != '\0';
 
-  // Anchor-contract unit phase first (single-threaded, needs the net's
-  // PSQT table for batched feature extraction).
+  // Anchor-contract unit phases first (single-threaded, need the net's
+  // PSQT table for batched feature extraction): the full-provide guard,
+  // then the ABI 9 anchors+PSQT wire cross-check.
   if (have_net && provide_guard_check(net_path) != 0) return 1;
+  if (have_net && anchors_psqt_check(net_path) != 0) return 1;
 
   // Small TT on purpose: eviction (the racier path — victim ranking,
   // generation reads, XOR re-stores) must fire constantly.

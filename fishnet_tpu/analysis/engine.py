@@ -197,6 +197,16 @@ class _Indexer(ast.NodeVisitor):
             qual = ".".join(self.class_stack + [node.name])
         else:
             qual = node.name
+        # Colliding qualnames (several `def _():` bodies under pl.when in
+        # one kernel, redefinitions) must each keep their own FuncInfo:
+        # last-wins indexing silently dropped every earlier body from
+        # R2's reachability scan.  `$n` cannot appear in source names, so
+        # the suffix never collides with a real qualname.
+        if qual in self.mod.functions:
+            n = 2
+            while f"{qual}${n}" in self.mod.functions:
+                n += 1
+            qual = f"{qual}${n}"
         imports = dict(self.import_stack[-1])
         info = FuncInfo(
             qualname=qual,

@@ -118,6 +118,21 @@ def _walk_own_body(func_node: ast.AST) -> Iterator[ast.AST]:
         stack.extend(ast.iter_child_nodes(node))
 
 
+def _nested_defs(func_node: ast.AST) -> Iterator[ast.AST]:
+    """Function definitions nested directly in a function's own body
+    (not inside deeper nested defs or lambdas) — the shape R2 needs to
+    see `@pl.when`-decorated kernel regions."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
 # ---------------------------------------------------------------------------
 # R2: host sync inside jit-traced code paths
 # ---------------------------------------------------------------------------
@@ -150,6 +165,17 @@ _HOST_SYNC_CALLS = {
 #: inputs only) — its subtree is exempt from R2.  `isinstance` qualifies
 #: because branching on Python types can never branch on traced VALUES.
 _CONCRETENESS_GUARDS = {"is_concrete", "is_tracer", "isinstance", "is_concrete_array"}
+
+#: Decorators that EXECUTE the decorated nested def under the enclosing
+#: trace (``@pl.when(cond)`` immediately traces the body as the
+#: predicated region of the surrounding Pallas kernel).  A nested def
+#: carrying one of these is a call-graph edge from its enclosing
+#: function — the fused-PSQT kernel's reduce paths live in exactly such
+#: defs, and without the edge R2 never scanned them.
+_TRACED_DECORATORS = {
+    "jax.experimental.pallas.when",
+    "jax.experimental.pallas.tpu.when",
+}
 
 
 class JitHostSyncRule:
@@ -224,7 +250,10 @@ class JitHostSyncRule:
         self, project: Project, mod: Module, info: Optional[FuncInfo], node: ast.AST
     ) -> Optional[FuncInfo]:
         """Resolve a function REFERENCE (not call): bare name, nested def,
-        self.method, or imported project function."""
+        self.method, or imported project function.  Bare names search the
+        lexical scope chain — a sibling nested def (``reduce_sparse``
+        called from a ``def _():`` under ``pl.when``) lives in the
+        ENCLOSING function's locals, not the caller's own."""
         if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
             if node.value.id == "self" and info is not None and info.class_name:
                 methods = mod.classes.get(info.class_name, {})
@@ -235,8 +264,15 @@ class JitHostSyncRule:
         dotted = project.resolve_dotted(node, imports)
         if dotted is None:
             return None
-        if info is not None and dotted in info.locals_:
-            return mod.functions.get(info.locals_[dotted])
+        if info is not None:
+            qn: Optional[str] = info.qualname
+            while qn is not None:
+                scope = mod.functions.get(qn)
+                if scope is not None and dotted in scope.locals_:
+                    return mod.functions.get(scope.locals_[dotted])
+                qn, sep, _ = qn.rpartition(".<locals>.")
+                if not sep:
+                    qn = None
         return project.find_function(dotted, mod)
 
     # -- reachability -----------------------------------------------------
@@ -272,12 +308,42 @@ class JitHostSyncRule:
             # Function REFERENCES passed as arguments also trace: jax.grad
             # /value_and_grad/vmap/lax.scan bodies, functools.partial, the
             # kernel handed to pallas_call — any of them may run under the
-            # caller's trace.
+            # caller's trace.  Lambdas passed as arguments run there too
+            # (``both_modes(pos, lambda lim, sp: transfer(...))`` in the
+            # fused gather kernel): resolve the calls their bodies make.
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 if isinstance(arg, (ast.Name, ast.Attribute)):
                     fa = self._resolve_func_ref(project, mod, info, arg)
                     if fa is not None:
                         yield fa
+                elif isinstance(arg, ast.Lambda):
+                    for sub in ast.walk(arg.body):
+                        if isinstance(sub, ast.Call):
+                            fa = self._resolve_func_ref(
+                                project, mod, info, sub.func
+                            )
+                            if fa is not None:
+                                yield fa
+        # Nested defs under a tracing decorator execute as part of THIS
+        # function's trace (`@pl.when(cond)` applies the body to the
+        # kernel's predicated region at definition time): edge to each.
+        for nested in _nested_defs(info.node):
+            for dec in nested.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if project.resolve_dotted(
+                    target, info.imports
+                ) in _TRACED_DECORATORS:
+                    fn = self._func_info_for_node(mod, nested)
+                    if fn is not None:
+                        yield fn
+                    break
+
+    @staticmethod
+    def _func_info_for_node(mod: Module, node: ast.AST) -> Optional[FuncInfo]:
+        for fi in mod.functions.values():
+            if fi.node is node:
+                return fi
+        return None
 
     # -- violation scan ---------------------------------------------------
 

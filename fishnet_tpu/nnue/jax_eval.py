@@ -99,11 +99,12 @@ def evaluate_batch(
 
     ``material`` (optional, int32 [B]): the bucket-selected PSQT
     material term, precomputed HOST-side by the native pool during
-    feature extraction (cpp/src/pool.cpp fill_full/fill_delta — a ~60
-    load walk over an L2-resident 720 KB table there vs a random row
-    gather over an 11 MB padded table here). When given, the device
-    skips the whole PSQT path; when None (tests, training, schema-level
-    callers) PSQT is gathered on device as before.
+    feature extraction (cpp/src/pool.cpp fill_full/fill_delta). When
+    given, the device skips the whole PSQT path; when None the PSQT
+    accumulator is produced ON DEVICE by the same fused pass that
+    builds the feature-transformer accumulators (ops/ft_gather.py
+    fused PSQT; the XLA fallback is bit-identical) — the production
+    wire ships no material at all (doc/wire-format.md).
     """
     indices = indices.astype(jnp.int32)
     # Feature transformer: fused Pallas gather-accumulate on TPU (single
@@ -111,11 +112,24 @@ def evaluate_batch(
     # anchor), XLA take+sum elsewhere. [B, 2, L1] int32.
     from fishnet_tpu.ops.ft_gather import ft_accumulate
 
+    psqt = None
     if parent is None:
         # Full entries only: no removal encodings can appear, so skip
-        # the decode arithmetic entirely in this trace.
-        acc = ft_accumulate(params["ft_w"], params["ft_b"], indices)
+        # the decode arithmetic entirely in this trace. Without host
+        # material the PSQT accumulator rides the same fused pass.
+        if material is None:
+            acc, psqt = ft_accumulate(
+                params["ft_w"], params["ft_b"], indices,
+                ft_psqt=params["ft_psqt"],
+            )
+        else:
+            acc = ft_accumulate(params["ft_w"], params["ft_b"], indices)
     else:
+        # Incremental entries: the dense entry point carries no anchor
+        # tables, so PSQT for material-less calls resolves in
+        # _evaluate_from_acc (XLA decode + in-batch refs; persistent
+        # codes poison there — the anchored packed path is where tables
+        # live, evaluate_packed_anchored).
         acc = ft_accumulate(
             params["ft_w"],
             params["ft_b"],
@@ -123,7 +137,9 @@ def evaluate_batch(
             delta_base=spec.DELTA_BASE,
             parent=parent,
         )
-    return _evaluate_from_acc(params, acc, indices, buckets, parent, material)
+    return _evaluate_from_acc(
+        params, acc, indices, buckets, parent, material, psqt=psqt
+    )
 
 
 def _evaluate_from_acc(
@@ -133,18 +149,23 @@ def _evaluate_from_acc(
     buckets: jax.Array,
     parent: Optional[jax.Array],
     material: Optional[jax.Array],
+    psqt: Optional[jax.Array] = None,
 ) -> jax.Array:
     """The network head past the feature transformer: clipped pairwise
     multiply, bucketed dense stack, PSQT/material blend (see
-    evaluate_batch for semantics). The on-device PSQT path resolves
-    IN-BATCH refs only — entries carrying persistent anchor codes must
-    ship a host-computed ``material`` (the anchor's PSQT lives host-side
-    in the pool slot, not in the device table)."""
-    if material is None:
+    evaluate_batch for semantics). ``psqt`` (int32 [B, 2, 8], fully
+    RESOLVED — the fused kernel's second output) short-circuits this
+    function's own XLA PSQT gather; without it the fallback here
+    resolves IN-BATCH refs only, so entries carrying persistent anchor
+    codes must either arrive with ``psqt`` (anchor-PSQT table path) or
+    ship a host-computed ``material``."""
+    psqt_resolved = psqt is not None  # tables resolved everything already
+    if material is None and psqt is None:
         if parent is not None and is_concrete(parent):
             if bool((np.asarray(parent) <= -2).any()):
                 raise ValueError(
-                    "persistent anchor codes require host-side material"
+                    "persistent anchor codes require host-side material "
+                    "or a device-resolved psqt"
                 )
         if parent is None:
             psqt_rows = jnp.take(params["ft_psqt"], indices, axis=0)
@@ -225,11 +246,11 @@ def _evaluate_from_acc(
             psqt, jnp.repeat(buckets[:, None, None], 2, axis=1), axis=2
         )[..., 0]
         material = _trunc_div(psqt_sel[:, 0] - psqt_sel[:, 1], 2)
-        if parent is not None:
+        if parent is not None and not psqt_resolved:
             # Structural twin of the eager guard above for TRACED parents:
-            # anchor-code entries (<= -2) have host-side PSQT state the
-            # device cannot see — poison their scores so the misuse is
-            # visible (see _POISON_MATERIAL).
+            # without a device-resolved psqt, anchor-code entries (<= -2)
+            # have PSQT state this fallback cannot see — poison their
+            # scores so the misuse is visible (see _POISON_MATERIAL).
             material = jnp.where(
                 parent.astype(jnp.int32) <= -2,
                 jnp.int32(_POISON_MATERIAL),
@@ -310,19 +331,28 @@ def evaluate_packed_anchored(
     packed: jax.Array,
     buckets: jax.Array,
     parent: jax.Array,
-    material: jax.Array,
+    material: Optional[jax.Array],
     anchor_tab: jax.Array,
     n_rows: jax.Array,
+    psqt_tab: jax.Array,
 ):
     """evaluate_batch over the compact wire with PERSISTENT device-
     resident anchors (VERDICT r4 item 1): ``anchor_tab`` [A, 2, L1]
     int32 holds one feature-transformer accumulator per pool slot of
     the dispatching group; persistent parent codes resolve against it,
     and every anchor entry's resolved accumulator is scattered back to
-    its row. Returns ``(values, new_anchor_tab)`` — the caller threads
-    the table into the next step's call, so it lives on the device
-    across steps and single demand evals ship one 32-byte row instead
-    of a 128-byte full entry.
+    its row. ``psqt_tab`` [A, 2, 8] int32 is its PSQT twin: with
+    ``material=None`` (the ABI 9 production wire) the PSQT accumulator
+    is produced by the same fused pass as the feature-transformer
+    accumulators, persistent codes resolve against ``psqt_tab``, and
+    anchor entries' resolved PSQT scatters back alongside — the wire
+    ships NO material and the old persistent-anchor poison limitation
+    is gone. With ``material`` given (host-material fallback wire) the
+    device PSQT path is skipped and ``psqt_tab`` rides through
+    untouched. Returns ``(values, new_anchor_tab, new_psqt_tab)`` —
+    the caller threads both tables into the next step's call, so they
+    live on the device across steps and single demand evals ship one
+    32-byte row instead of a 128-byte full entry.
 
     Two wire arrays are GONE relative to evaluate_packed: row offsets
     (derivable — entries own 4 rows when full, 1 when delta, so offsets
@@ -334,7 +364,6 @@ def evaluate_packed_anchored(
     DMAs in the fused kernel), so every offset clamps to ``n_rows``,
     where the service writes one sentinel block.
     """
-    assert material is not None, "the native pool always ships material"
     from fishnet_tpu.ops.ft_gather import decode_parent, ft_accumulate
 
     parent = parent.astype(jnp.int32)
@@ -342,15 +371,30 @@ def evaluate_packed_anchored(
     offsets = jnp.cumsum(rows_per) - rows_per  # exclusive prefix sum
     offsets = jnp.minimum(offsets, n_rows.astype(jnp.int32)[0])
     dense = expand_packed(packed, offsets, parent)
-    acc = ft_accumulate(
-        params["ft_w"],
-        params["ft_b"],
-        dense,
-        delta_base=spec.DELTA_BASE,
-        parent=parent,
-        anchor_tab=anchor_tab,
+    psqt = None
+    if material is None:
+        acc, psqt = ft_accumulate(
+            params["ft_w"],
+            params["ft_b"],
+            dense,
+            delta_base=spec.DELTA_BASE,
+            parent=parent,
+            anchor_tab=anchor_tab,
+            ft_psqt=params["ft_psqt"],
+            psqt_tab=psqt_tab,
+        )
+    else:
+        acc = ft_accumulate(
+            params["ft_w"],
+            params["ft_b"],
+            dense,
+            delta_base=spec.DELTA_BASE,
+            parent=parent,
+            anchor_tab=anchor_tab,
+        )
+    values = _evaluate_from_acc(
+        params, acc, dense, buckets, parent, material, psqt=psqt
     )
-    values = _evaluate_from_acc(params, acc, dense, buckets, parent, material)
     # Store anchor entries' resolved accumulators back to their rows.
     # Rows are unique within a batch (one block per pool slot per step),
     # so the scatter has no conflicts; non-anchor entries aim past the
@@ -360,15 +404,17 @@ def evaluate_packed_anchored(
     new_tab = anchor_tab.at[row].set(
         acc.reshape(parent.shape[0], 2, -1), mode="drop"
     )
-    return values, new_tab
+    new_psqt_tab = psqt_tab
+    if psqt is not None:
+        new_psqt_tab = psqt_tab.at[row].set(psqt, mode="drop")
+    return values, new_tab, new_psqt_tab
 
 
-#: The anchor table is DONATED: the scatter updates it in place instead
-#: of copying the whole table every step (callers must rebind their
-#: handle to the returned table — the input buffer is dead after the
-#: call).
+#: The anchor tables are DONATED: the scatters update them in place
+#: instead of copying every step (callers must rebind their handles to
+#: the returned tables — the input buffers are dead after the call).
 evaluate_packed_anchored_jit = jax.jit(
-    evaluate_packed_anchored, donate_argnums=(5,)
+    evaluate_packed_anchored, donate_argnums=(5, 7)
 )
 
 

@@ -24,6 +24,14 @@ instead shipped partial accumulators and resolved references with a
 batch-wide XLA gather over [B, 2, L1] int32 — a full extra HBM pass
 (~2 ms per 16k batch) that this design deletes outright.
 
+The same pass now also produces the [B, 2, 8] PSQT accumulator
+(``ft_psqt`` given): the PSQT columns ride the same decoded index
+stream as 32-byte DMAs next to the 2 KiB feature rows, with the same
+running-anchor discipline and a persistent anchor-PSQT table next to
+the accumulator table — so anchor-code entries resolve ENTIRELY on
+device and the wire no longer needs the host-computed material term
+(doc/wire-format.md).
+
 Used by jax_eval.evaluate_batch on TPU backends; the plain XLA path
 remains the fallback (CPU tests, odd shapes) and the parity test runs
 this kernel in interpreter mode against it.
@@ -72,6 +80,24 @@ def _xla_ft_accumulate(
     return ft_b.astype(jnp.int32) + jnp.sum(rows, axis=2)
 
 
+def _xla_psqt_accumulate(
+    ft_psqt: jax.Array,
+    indices: jax.Array,
+    delta_base: int | None = None,
+) -> jax.Array:
+    """PSQT accumulators over the same index stream as the FT gather:
+    int32 [B, 2, 8], no bias term. Removal encodings subtract their row;
+    pads decode to the zero sentinel row either way."""
+    if delta_base is not None:
+        is_rem = indices >= delta_base
+        indices = jnp.where(is_rem, indices - delta_base, indices)
+        sign = jnp.where(is_rem, -1, 1)
+    rows = jnp.take(ft_psqt, indices, axis=0)  # [B, 2, A, 8] int32
+    if delta_base is not None:
+        rows = rows * sign[..., None]
+    return jnp.sum(rows, axis=2)
+
+
 def _swap_persp(a: jax.Array, swap: jax.Array) -> jax.Array:
     """Swap the perspective axis (axis 1 of [B, 2, ...]) where ``swap``."""
     perm = jnp.where(swap[:, None], jnp.array([1, 0]), jnp.array([0, 1]))
@@ -104,7 +130,7 @@ def decode_parent(parent: jax.Array):
 
 def _xla_resolve_parents(
     acc: jax.Array,
-    ft_b: jax.Array,
+    bias: jax.Array,
     parent: jax.Array,
     anchor_tab: Optional[jax.Array] = None,
 ) -> jax.Array:
@@ -114,9 +140,13 @@ def _xla_resolve_parents(
     never in-batch deltas, so their resolution is final), then in-batch
     deltas gather their — now resolved — anchor entries. Exact: integer
     adds commute, so delta partial + referenced accumulator - (the
-    doubly counted) bias is bit-identical to a full gather."""
+    doubly counted) bias is bit-identical to a full gather.
+
+    ``bias`` is whatever the partials already include and must not be
+    double-counted: the FT bias for the feature-transformer accumulator,
+    a zero scalar for the (bias-free) PSQT accumulator. Works for any
+    trailing accumulator shape ([B, 2, L1] and [B, 2, 8] alike)."""
     in_batch, persistent, _, ref, swap, aid = decode_parent(parent)
-    bias = ft_b.astype(jnp.int32)
     if anchor_tab is not None:
         tab_acc = _swap_persp(
             jnp.take(anchor_tab.astype(jnp.int32), aid, axis=0), swap
@@ -151,14 +181,23 @@ _SPARSE_SLOTS = 2 * _DELTA_SLOTS
 
 
 def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
-            tab_ref, out_ref, rows, sems, anchor, pa, pa_sems, *,
-            delta_base, anchored):
+            tab_ref, *rest, delta_base, anchored, with_psqt):
     # Software-pipelined gather: scratch holds TWO positions' rows. Grid
     # step b waits on the buffer its predecessor filled for it, issues
     # position b+1's row DMAs into the other buffer, then reduces — so
     # row copies stay in flight at all times and the HBM pipe never
     # drains between positions. Row addresses come from the scalar-
     # prefetched index operand, available before the body runs.
+    #
+    # FUSED PSQT (with_psqt): the same index stream also drives a second,
+    # tiny DMA per row — the feature's 8-bucket PSQT column (32 bytes vs
+    # the 2 KiB FT row, so the extra traffic is noise against the row
+    # DMAs it rides with) — and the reduce produces a second [2, 8]
+    # accumulator per position with the SAME anchor discipline (running
+    # in-VMEM anchor, persistent rows from a [A, 2, 8] anchor-PSQT
+    # table). Integer adds commute, so the fused PSQT is bit-identical
+    # to the XLA gather path and to the host-side material walk the wire
+    # used to ship.
     #
     # Per-position flags (scalar-prefetched, so the issuing step for b+1
     # and the waiting step at b+1 always agree): bit 0 = sparse
@@ -173,13 +212,21 @@ def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
     # all slots as plain additions. Table WRITES happen outside the
     # kernel (jax_eval scatters the output accumulators of anchor
     # entries back into the table).
+    if with_psqt:
+        (pq_ref, pcarry_ref, ptab_ref, out_ref, pout_ref, rows, sems,
+         anchor, pa, pa_sems, pq_rows, pq_sems, pq_anchor, pq_pa,
+         pq_pa_sems) = rest
+    else:
+        out_ref, rows, sems, anchor, pa, pa_sems = rest
+
     b = pl.program_id(0)
     n = pl.num_programs(0)
     n_active = rows.shape[1] // 2  # both perspectives share a buffer
 
     def transfer(pos, slot, start, limit, is_sparse):
         # Each feature row is one native (sub, 128) int16 tile, so
-        # single-row HBM slices stay tile-aligned.
+        # single-row HBM slices stay tile-aligned. The PSQT column rides
+        # the same decoded index (32-byte DMA alongside the 2 KiB row).
         for p in range(2):
             for k in range(limit):
                 idx = idx_ref[pos, p, k]
@@ -190,6 +237,12 @@ def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
                     ft_ref.at[idx], rows.at[slot, i], sems.at[slot, i],
                 )
                 dma.start() if start else dma.wait()
+                if with_psqt:
+                    pdma = pltpu.make_async_copy(
+                        pq_ref.at[idx], pq_rows.at[slot, i],
+                        pq_sems.at[slot, i],
+                    )
+                    pdma.start() if start else pdma.wait()
 
     def both_modes(pos, fn):
         # fn(limit, is_sparse); the flag is explicit rather than inferred
@@ -209,9 +262,10 @@ def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
             fn(n_active, False)
 
     def anchor_dma(pos, slot, start):
-        # One DMA for the whole [2, sub, 128] anchor row; issued/awaited
-        # only for persistent entries (scalar-prefetched flag, so the
-        # issuing step for b+1 and the waiting step at b+1 agree).
+        # One DMA for the whole [2, sub, 128] anchor row (plus its
+        # [2, 8] PSQT twin when fused); issued/awaited only for
+        # persistent entries (scalar-prefetched flag, so the issuing
+        # step for b+1 and the waiting step at b+1 agree).
         if not anchored:
             return
 
@@ -221,6 +275,12 @@ def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
                 tab_ref.at[aid_ref[pos]], pa.at[slot], pa_sems.at[slot]
             )
             dma.start() if start else dma.wait()
+            if with_psqt:
+                pdma = pltpu.make_async_copy(
+                    ptab_ref.at[aid_ref[pos]], pq_pa.at[slot],
+                    pq_pa_sems.at[slot],
+                )
+                pdma.start() if start else pdma.wait()
 
     slot = jax.lax.rem(b, 2)
 
@@ -233,6 +293,8 @@ def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
             # chunk (zeros for the first — the pool guarantees batch
             # entry 0 is an anchor entry, so it is never read there).
             anchor[...] = carry_ref[...]
+            if with_psqt:
+                pq_anchor[...] = pcarry_ref[...]
 
     @pl.when(b + 1 < n)
     def _():
@@ -253,11 +315,17 @@ def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
                 rows[slot, base : base + limit].astype(jnp.int32), axis=0
             )
             out_ref[0, p] = acc
+            if with_psqt:
+                pq = jnp.sum(pq_rows[slot, base : base + limit], axis=0)
+                pout_ref[0, p] = pq
             if anchored:
                 anchor[p] = acc
+                if with_psqt:
+                    pq_anchor[p] = pq
 
     def reduce_sparse():
         partial = []
+        pq_partial = []
         for p in range(2):
             base = p * n_active
             adds = jnp.sum(
@@ -270,9 +338,21 @@ def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
                 axis=0,
             )
             partial.append(adds - rems)
+            if with_psqt:
+                pq_partial.append(
+                    jnp.sum(pq_rows[slot, base : base + _DELTA_SLOTS], axis=0)
+                    - jnp.sum(
+                        pq_rows[
+                            slot, base + _DELTA_SLOTS : base + _SPARSE_SLOTS
+                        ],
+                        axis=0,
+                    )
+                )
         if not anchored:
             for p in range(2):
                 out_ref[0, p] = bias + partial[p]
+                if with_psqt:
+                    pout_ref[0, p] = pq_partial[p]
             return
         # Resolve against the running anchor (the most recent anchor
         # entry), or — persistent entries — the anchor-table row DMA'd
@@ -288,6 +368,17 @@ def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
         ]
         for p in range(2):
             out_ref[0, p] = res[p]
+        if with_psqt:
+            pq_base = [
+                jnp.where(persistent, pq_pa[slot, p], pq_anchor[p])
+                for p in range(2)
+            ]
+            pq_res = [
+                jnp.where(swap, pq_base[1 - p], pq_base[p]) + pq_partial[p]
+                for p in range(2)
+            ]
+            for p in range(2):
+                pout_ref[0, p] = pq_res[p]
 
         @pl.when(persistent)
         def _():
@@ -295,6 +386,8 @@ def _kernel(idx_ref, flags_ref, aid_ref, ft_ref, bias_ref, carry_ref,
             # in-batch deltas of its block reference it.
             for p in range(2):
                 anchor[p] = res[p]
+                if with_psqt:
+                    pq_anchor[p] = pq_res[p]
 
     if delta_base is None:
         reduce_full(n_active)
@@ -329,12 +422,18 @@ def _pallas_ft_accumulate(
     flags: Optional[jax.Array] = None,
     anchor_ids: Optional[jax.Array] = None,
     anchor_tab: Optional[jax.Array] = None,
+    ft_psqt: Optional[jax.Array] = None,
+    psqt_tab: Optional[jax.Array] = None,
     interpret: bool = False,
     delta_base: int | None = None,
     anchored: bool = False,
-) -> jax.Array:
+):
+    """Returns [B, 2, L1] int32 accumulators, or — with ``ft_psqt``
+    given — the tuple (accumulators, [B, 2, 8] int32 PSQT accumulators)
+    from one fused pass over the index stream."""
     batch, persp, n_active = indices.shape
     l1 = ft_w.shape[1]
+    with_psqt = ft_psqt is not None
     assert persp == 2, "indices must be [B, 2, MAX_ACTIVE]"
     assert l1 % 1024 == 0, "L1 must fold into whole (8, 128) int16 tiles"
     sub = l1 // 128  # sublane count of one feature row viewed as a tile
@@ -349,38 +448,77 @@ def _pallas_ft_accumulate(
         tab_tiles = jnp.zeros((1, 2, sub, 128), jnp.int32)
     else:
         tab_tiles = anchor_tab.astype(jnp.int32).reshape(-1, 2, sub, 128)
+    n_buckets = 0
+    pq_rows = ptab = None
+    if with_psqt:
+        n_buckets = ft_psqt.shape[1]
+        pq_rows = ft_psqt.astype(jnp.int32)  # [rows, 8] in HBM
+        if psqt_tab is None:
+            ptab = jnp.zeros((1, 2, n_buckets), jnp.int32)
+        else:
+            ptab = psqt_tab.astype(jnp.int32)
 
-    def run_chunk(idx_chunk, flags_chunk, aid_chunk, carry):
+    def run_chunk(idx_chunk, flags_chunk, aid_chunk, carry, pcarry):
         chunk = idx_chunk.shape[0]
+        in_specs = [
+            pl.BlockSpec(memory_space=pltpu.ANY),  # ft_w stays in HBM
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # bias
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # anchor carry-in
+            pl.BlockSpec(memory_space=pltpu.ANY),  # anchor table (HBM)
+        ]
+        out_specs = pl.BlockSpec(
+            (1, 2, sub, 128),
+            lambda b, idx_ref, flags_ref, aid_ref: (b, 0, 0, 0),
+        )
+        out_shape = jax.ShapeDtypeStruct((chunk, 2, sub, 128), jnp.int32)
+        scratch = [
+            pltpu.VMEM((2, 2 * n_active, sub, 128), ft_w.dtype),
+            pltpu.SemaphoreType.DMA((2, 2 * n_active)),
+            pltpu.VMEM((2, sub, 128), jnp.int32),  # running anchor
+            pltpu.VMEM((2, 2, sub, 128), jnp.int32),  # persistent rows
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+        operands = [idx_chunk, flags_chunk, aid_chunk, ft_tiles, bias_tile,
+                    carry, tab_tiles]
+        if with_psqt:
+            in_specs += [
+                pl.BlockSpec(memory_space=pltpu.ANY),  # PSQT columns (HBM)
+                pl.BlockSpec(memory_space=pltpu.VMEM),  # PSQT carry-in
+                pl.BlockSpec(memory_space=pltpu.ANY),  # anchor-PSQT table
+            ]
+            out_specs = [
+                out_specs,
+                pl.BlockSpec(
+                    (1, 2, n_buckets),
+                    lambda b, idx_ref, flags_ref, aid_ref: (b, 0, 0),
+                ),
+            ]
+            out_shape = [
+                out_shape,
+                jax.ShapeDtypeStruct((chunk, 2, n_buckets), jnp.int32),
+            ]
+            scratch += [
+                pltpu.VMEM((2, 2 * n_active, n_buckets), jnp.int32),
+                pltpu.SemaphoreType.DMA((2, 2 * n_active)),
+                pltpu.VMEM((2, n_buckets), jnp.int32),  # running PSQT anchor
+                pltpu.VMEM((2, 2, n_buckets), jnp.int32),  # persistent rows
+                pltpu.SemaphoreType.DMA((2,)),
+            ]
+            operands += [pq_rows, pcarry, ptab]
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,  # indices + flags + anchor row ids
             grid=(chunk,),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.ANY),  # ft_w stays in HBM
-                pl.BlockSpec(memory_space=pltpu.VMEM),  # bias
-                pl.BlockSpec(memory_space=pltpu.VMEM),  # anchor carry-in
-                pl.BlockSpec(memory_space=pltpu.ANY),  # anchor table (HBM)
-            ],
-            out_specs=pl.BlockSpec(
-                (1, 2, sub, 128),
-                lambda b, idx_ref, flags_ref, aid_ref: (b, 0, 0, 0),
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((2, 2 * n_active, sub, 128), ft_w.dtype),
-                pltpu.SemaphoreType.DMA((2, 2 * n_active)),
-                pltpu.VMEM((2, sub, 128), jnp.int32),  # running anchor
-                pltpu.VMEM((2, 2, sub, 128), jnp.int32),  # persistent rows
-                pltpu.SemaphoreType.DMA((2,)),
-            ],
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
         )
         return pl.pallas_call(
             functools.partial(_kernel, delta_base=delta_base,
-                              anchored=anchored),
-            out_shape=jax.ShapeDtypeStruct((chunk, 2, sub, 128), jnp.int32),
+                              anchored=anchored, with_psqt=with_psqt),
+            out_shape=out_shape,
             grid_spec=grid_spec,
             interpret=interpret,
-        )(idx_chunk, flags_chunk, aid_chunk, ft_tiles, bias_tile, carry,
-          tab_tiles)
+        )(*operands)
 
     idx = indices.astype(jnp.int32)
     if flags is None:
@@ -392,12 +530,17 @@ def _pallas_ft_accumulate(
     else:
         anchor_ids = anchor_ids.astype(jnp.int32)
     carry = jnp.zeros((2, sub, 128), jnp.int32)
+    pcarry = jnp.zeros((2, n_buckets), jnp.int32) if with_psqt else None
     outs = []
+    pouts = []
     for start in range(0, batch, _CHUNK):
         idx_c = idx[start : start + _CHUNK]
         fl_c = flags[start : start + _CHUNK]
         aid_c = anchor_ids[start : start + _CHUNK]
-        out = run_chunk(idx_c, fl_c, aid_c, carry)
+        out = run_chunk(idx_c, fl_c, aid_c, carry, pcarry)
+        if with_psqt:
+            out, pout = out
+            pouts.append(pout)
         outs.append(out)
         if anchored and start + _CHUNK < batch:
             # Next chunk's carry-in: the accumulator of the last ANCHOR
@@ -412,8 +555,18 @@ def _pallas_ft_accumulate(
             carry = jnp.where(
                 has_anchor, jnp.take(out, last_anchor, axis=0), carry
             )
+            if with_psqt:
+                pcarry = jnp.where(
+                    has_anchor,
+                    jnp.take(pouts[-1], last_anchor, axis=0),
+                    pcarry,
+                )
     out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
-    return out.reshape(batch, persp, l1)
+    acc = out.reshape(batch, persp, l1)
+    if not with_psqt:
+        return acc
+    pout = pouts[0] if len(pouts) == 1 else jnp.concatenate(pouts, axis=0)
+    return acc, pout
 
 
 def ft_accumulate(
@@ -427,7 +580,9 @@ def ft_accumulate(
     sparse: Optional[jax.Array] = None,
     parent: Optional[jax.Array] = None,
     anchor_tab: Optional[jax.Array] = None,
-) -> jax.Array:
+    ft_psqt: Optional[jax.Array] = None,
+    psqt_tab: Optional[jax.Array] = None,
+):
     """Feature-transformer accumulators, bias included: int32 [B, 2, L1].
 
     ``ft_w`` [rows, L1] int16 whose LAST row is the zero sentinel;
@@ -454,10 +609,19 @@ def ft_accumulate(
       back as bias-included PARTIALS (adds - removes); the caller owns
       resolution. (Kept for tests and schema-level users.)
 
+    FUSED PSQT: with ``ft_psqt`` ([rows, 8] int32, same zero sentinel
+    last row as ``ft_w``) the return value is the tuple ``(acc, psqt)``
+    where ``psqt`` is the int32 [B, 2, 8] PSQT accumulator built from
+    the SAME index stream in the same pass — same removal decoding,
+    same anchor resolution (persistent codes resolve against
+    ``psqt_tab`` [A, 2, 8], the anchor-PSQT twin of ``anchor_tab``).
+    Bit-identical to the XLA gather and to the host material walk.
+
     ``use_pallas=None`` auto-selects: the fused kernel on TPU backends
     when shapes conform (lane-aligned L1), XLA otherwise.
     """
     indices = indices.astype(jnp.int32)
+    with_psqt = ft_psqt is not None
     if use_pallas is None:
         use_pallas = (
             jax.default_backend() == "tpu" and ft_w.shape[1] % 1024 == 0
@@ -495,19 +659,38 @@ def ft_accumulate(
             )
             acc = _pallas_ft_accumulate(
                 ft_w, ft_b, indices, flags, aid, anchor_tab,
+                ft_psqt, psqt_tab,
                 interpret=interpret, delta_base=delta_base, anchored=True,
             )
+            psqt = None
+            if with_psqt:
+                acc, psqt = acc
             if anchor_tab is None:
                 acc = jnp.where(
                     persistent[:, None, None], jnp.int32(_POISON_ACC), acc
                 )
-            return acc
+                if with_psqt:
+                    psqt = jnp.where(
+                        persistent[:, None, None], jnp.int32(_POISON_ACC),
+                        psqt,
+                    )
+            return (acc, psqt) if with_psqt else acc
         acc = _xla_ft_accumulate(ft_w, ft_b, indices, delta_base=delta_base)
-        return _xla_resolve_parents(acc, ft_b, parent, anchor_tab)
+        acc = _xla_resolve_parents(
+            acc, ft_b.astype(jnp.int32), parent, anchor_tab
+        )
+        if not with_psqt:
+            return acc
+        psqt = _xla_psqt_accumulate(ft_psqt, indices, delta_base=delta_base)
+        psqt = _xla_resolve_parents(psqt, jnp.int32(0), parent, psqt_tab)
+        return acc, psqt
     if use_pallas or interpret:
         flags = None if sparse is None else sparse.astype(jnp.int32)
         return _pallas_ft_accumulate(
-            ft_w, ft_b, indices, flags,
+            ft_w, ft_b, indices, flags, ft_psqt=ft_psqt,
             interpret=interpret, delta_base=delta_base,
         )
-    return _xla_ft_accumulate(ft_w, ft_b, indices, delta_base=delta_base)
+    acc = _xla_ft_accumulate(ft_w, ft_b, indices, delta_base=delta_base)
+    if not with_psqt:
+        return acc
+    return acc, _xla_psqt_accumulate(ft_psqt, indices, delta_base=delta_base)

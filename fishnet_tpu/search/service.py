@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import ctypes
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -368,6 +369,7 @@ class SearchService:
         # pipeline (the next call consumes the previous call's returned
         # table) while different groups' calls overlap freely.
         self._anchor_tabs = None
+        self._psqt_tabs = None
         if backend == "jax" and evaluator is None:
             import jax
             import jax.numpy as jnp
@@ -378,6 +380,16 @@ class SearchService:
                                          jnp.int32))
                 for _ in range(self._n_groups)
             ]
+            # Anchor-PSQT twin tables (ABI 9): one [rows, 2, 8] PSQT
+            # accumulator per pool slot, threaded through every anchored
+            # eval exactly like the accumulator table — what lets the
+            # device resolve persistent-anchor PSQT without the host
+            # material term on the wire.
+            self._psqt_tabs = [
+                jax.device_put(jnp.zeros(
+                    (rows_per_group, 2, spec.NUM_PSQT_BUCKETS), jnp.int32))
+                for _ in range(self._n_groups)
+            ]
             self._lib.fc_pool_set_anchors(self._pool, 1)
         # (_sharded_packed — the packed-capable mesh predicate — is set
         # once above, before the _eval_fn selection.) Sharded evaluators
@@ -386,6 +398,33 @@ class SearchService:
         # path previously paid the exact 4x wire cost the packed format
         # was built to delete (VERDICT r4 item 4 / weak 5).
         self._packed_wire = backend == "jax" and evaluator is None
+        # DEVICE-RESIDENT PSQT (ABI 9): with the built-in anchored
+        # evaluator the fused gather pass also produces the PSQT
+        # accumulators (persistent codes resolve against the anchor-PSQT
+        # tables above), so the host material term leaves the hot wire
+        # entirely — 4 bytes/position and one random-gather pass gone.
+        # FISHNET_HOST_MATERIAL=1 restores the legacy host-material wire
+        # (the CPU/XLA fallback term the pool still computes).
+        self._device_psqt = self._packed_wire and (
+            os.environ.get("FISHNET_HOST_MATERIAL", "0") != "1"
+        )
+        if not self._packed_wire:
+            # External evaluators (sharded meshes, test doubles) keep
+            # the host-material wire.
+            self.psqt_path = "host-material"
+        elif not self._device_psqt:
+            self.psqt_path = "host-material"
+        else:
+            import jax
+
+            # Which executor serves the device PSQT: the fused Pallas
+            # kernel on conforming TPU backends, the bit-identical XLA
+            # fallback elsewhere (mirrors ft_gather's auto-select).
+            self.psqt_path = (
+                "fused"
+                if jax.default_backend() == "tpu" and spec.L1 % 1024 == 0
+                else "xla"
+            )
         self._packed_buf = np.empty((k, 4 * cap + 4, 2, 8), dtype=np.uint16)
         self._offset_buf = np.empty((k, cap), dtype=np.int32)
         self._bucket_buf = np.empty((k, cap), dtype=np.int32)
@@ -394,9 +433,12 @@ class SearchService:
         # full entry) emitted by the pool alongside the features.
         self._parent_buf = np.empty((k, cap), dtype=np.int32)
         # Host-computed material term (bucket-selected PSQT difference,
-        # cpp fill_full/fill_delta): 4 bytes/position on the wire buys
-        # the device out of the whole PSQT gather.
-        self._material_buf = np.empty((k, cap), dtype=np.int32)
+        # cpp fill_full/fill_delta): only allocated when it actually
+        # rides the wire — the device-psqt hot path passes a NULL
+        # material pointer to fc_pool_step (optional since ABI 9).
+        self._material_buf = (
+            None if self._device_psqt else np.empty((k, cap), dtype=np.int32)
+        )
         # Per-thread state: each driver thread owns one cell of each
         # list, so the hot paths touch no shared structure (the shared
         # _lock guards only the event-loop handoff queues).
@@ -407,7 +449,11 @@ class SearchService:
         # step that ships the 1k bucket is not "5% occupied".
         self._eval_steps = [0] * T
         self._bucket_slots = [0] * T
-        self._wire_bytes = [0] * T  # host->device payload actually shipped
+        # Host->device payload actually shipped, split feature-side
+        # (packed rows + buckets + parents + row count) vs the material
+        # term — the split is what shows the ABI 9 wire saving in BENCH.
+        self._wire_feature_bytes = [0] * T
+        self._wire_material_bytes = [0] * T
         self._pending: List[Dict[int, _Pending]] = [{} for _ in range(T)]
         self._submissions: List[List[Tuple]] = [[] for _ in range(T)]
         self._cancelled_tokens: List[set] = [set() for _ in range(T)]
@@ -538,16 +584,23 @@ class SearchService:
                         return
                     bucks = np.zeros((s,), np.int32)
                     parents = np.full((s,), -1, np.int32)
-                    material = np.zeros((s,), np.int32)
+                    material = (
+                        None if self._device_psqt
+                        else np.zeros((s,), np.int32)
+                    )
                     if self._packed_wire:
                         packed = np.full(
                             (tier, 2, 8), spec.NUM_FEATURES, np.uint16
                         )
-                        # The table is DONATED: rebind the handle or the
-                        # next call would use a dead buffer.
-                        values, self._anchor_tabs[0] = self._eval_fn(
-                            self._params, packed, bucks, parents, material,
-                            self._anchor_tabs[0], np.zeros((1,), np.int32),
+                        # The tables are DONATED: rebind the handles or
+                        # the next call would use dead buffers.
+                        values, self._anchor_tabs[0], self._psqt_tabs[0] = (
+                            self._eval_fn(
+                                self._params, packed, bucks, parents,
+                                material, self._anchor_tabs[0],
+                                np.zeros((1,), np.int32),
+                                self._psqt_tabs[0],
+                            )
                         )
                         np.asarray(values)
                     else:
@@ -609,10 +662,15 @@ class SearchService:
             "dedup_retired", "nodes", "anchor_deltas",
         )[:n])}
         # Service-side: slots actually transferred (size-bucketed) and
-        # host->device payload bytes shipped (the compact wire's metric).
+        # host->device payload bytes shipped (the compact wire's metric),
+        # split feature vs material so the ABI 9 saving is measurable.
         out["eval_steps"] = sum(self._eval_steps)
         out["bucket_slots"] = sum(self._bucket_slots)
-        out["wire_bytes"] = sum(self._wire_bytes)
+        out["wire_feature_bytes"] = sum(self._wire_feature_bytes)
+        out["wire_material_bytes"] = sum(self._wire_material_bytes)
+        out["wire_bytes"] = (
+            out["wire_feature_bytes"] + out["wire_material_bytes"]
+        )
         return out
 
     def is_alive(self) -> bool:
@@ -696,14 +754,17 @@ class SearchService:
         offsets = self._offset_buf[group]
         buckets = self._bucket_buf[group]
         parents = self._parent_buf[group]
-        material = self._material_buf[group]
+        material = (
+            None if self._material_buf is None else self._material_buf[group]
+        )
         # Padding entries: all share 4 sentinel rows appended past the
         # emitted stream, decoding to all-sentinel full entries.
         packed[rows : rows + 4] = spec.NUM_FEATURES
         offsets[n:size] = rows
         buckets[n:size] = 0
         parents[n:size] = -1
-        material[n:size] = 0
+        if material is not None:
+            material[n:size] = 0
         if self._packed_wire:
             tier = self._row_tiers(size)[-1]
             for rt in self._row_tiers(size):
@@ -715,12 +776,20 @@ class SearchService:
             # row count ships as a 4-byte scalar and padding entries
             # clamp into the sentinel block at packed[rows:rows+4] —
             # the offsets array is off the wire entirely
-            # (evaluate_packed_anchored).
-            self._wire_bytes[t] += tier * 2 * 8 * 2 + size * 3 * 4 + 4
-            values, self._anchor_tabs[group] = self._eval_fn(
-                self._params, packed[:tier], buckets[:size],
-                parents[:size], material[:size], self._anchor_tabs[group],
-                np.array([rows], np.int32),
+            # (evaluate_packed_anchored). With device PSQT the material
+            # column is off the wire too (its bytes are accounted
+            # separately so BENCH shows the saving).
+            self._wire_feature_bytes[t] += tier * 2 * 8 * 2 + size * 2 * 4 + 4
+            if material is not None:
+                self._wire_material_bytes[t] += size * 4
+            values, self._anchor_tabs[group], self._psqt_tabs[group] = (
+                self._eval_fn(
+                    self._params, packed[:tier], buckets[:size],
+                    parents[:size],
+                    None if material is None else material[:size],
+                    self._anchor_tabs[group], np.array([rows], np.int32),
+                    self._psqt_tabs[group],
+                )
             )
             return values
         if self._sharded_packed:
@@ -734,7 +803,8 @@ class SearchService:
         feats = expand_packed_np(
             packed[: rows + 4], offsets[:size], parents[:size]
         )
-        self._wire_bytes[t] += feats.nbytes + size * 3 * 4
+        self._wire_feature_bytes[t] += feats.nbytes + size * 2 * 4
+        self._wire_material_bytes[t] += size * 4
         return self._eval_fn(
             self._params, feats, buckets[:size], parents[:size],
             material[:size],
@@ -782,7 +852,8 @@ class SearchService:
                 # Padding entries decode as all-sentinel fulls from the
                 # shard's own trailing sentinel block.
                 out_offsets[real_hi:hi] = tier - 4
-        self._wire_bytes[t] += mult * tier * 2 * 8 * 2 + size * 4 * 4
+        self._wire_feature_bytes[t] += mult * tier * 2 * 8 * 2 + size * 3 * 4
+        self._wire_material_bytes[t] += size * 4
         return self._eval_fn(
             self._params, out_packed, out_offsets, buckets[:size],
             parents[:size], material[:size],
@@ -841,8 +912,16 @@ class SearchService:
             g: self._parent_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
             for g in groups
         }
+        # ABI 9: the material column is OPTIONAL on the wire — the
+        # device-psqt hot path hands the pool a NULL pointer and the
+        # pool skips the column (the fused/XLA device PSQT replaces it).
         material_ptrs = {
-            g: self._material_buf[g].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            g: (
+                None if self._material_buf is None
+                else self._material_buf[g].ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int32)
+                )
+            )
             for g in groups
         }
         # In-flight device evals per group: group -> (n, dispatched array).

@@ -17,6 +17,25 @@ from typing import List, Optional, TextIO
 from fishnet_tpu.configure import Opt
 
 
+def _unit_user() -> str:
+    """User= value for the system unit: $USER when set, else the real
+    account name from the password database (getpass checks LOGNAME/
+    USER/LNAME/USERNAME then pwd) — a unit with a literal placeholder
+    would fail to start at systemctl time."""
+    user = os.environ.get("USER")
+    if user:
+        return user
+    import getpass
+
+    try:
+        return getpass.getuser()
+    except (KeyError, OSError):
+        # No passwd entry for the uid (containers): nobody is the
+        # conventional unprivileged fallback and at least names a real
+        # account on any systemd host.
+        return "nobody"
+
+
 def _duration(seconds: float) -> str:
     """Serialize a duration so parse_duration round-trips it: integer
     seconds when whole, else milliseconds (parse_duration rejects
@@ -104,7 +123,7 @@ def systemd_system(opt: Opt, out: Optional[TextIO] = None) -> None:
         f"ExecStart={_exec_start(opt, absolute=True)} run",
         "KillMode=mixed",
         "WorkingDirectory=/tmp",
-        f"User={os.environ.get('USER', 'XXX')}",
+        f"User={_unit_user()}",
         "Nice=5",
         "CapabilityBoundingSet=",
         "PrivateTmp=true",

@@ -56,3 +56,34 @@ def static_ok(x):
 
 
 static_jit = jax.jit(static_ok)
+
+
+# -- fused-PSQT kernel shape (ops/ft_gather.py): host syncs reachable
+# only through a lambda argument and pl.when-decorated nested defs —
+# the call-graph edges added for the fused PSQT path.
+from jax.experimental import pallas as pl  # noqa: E402
+
+
+def _psqt_kernel(idx_ref, pout_ref, *, with_psqt):
+    def transfer(k):
+        return np.asarray(idx_ref)  # VIOLATION line 69 (lambda edge)
+
+    def both_modes(fn):
+        return fn(0)
+
+    @pl.when(with_psqt)
+    def _():
+        pout_ref[0] = np.asarray(idx_ref).sum()  # VIOLATION line 76
+
+    @pl.when(not with_psqt)
+    def _():
+        host = np.asarray(pout_ref)  # VIOLATION line 80 (2nd `_` def)
+        return host
+
+    return both_modes(lambda k: transfer(k))
+
+
+fused_psqt = pl.pallas_call(
+    functools.partial(_psqt_kernel, with_psqt=True),
+    out_shape=None,
+)

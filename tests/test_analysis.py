@@ -68,6 +68,9 @@ def test_r2_fires_on_known_lines():
         ("R2", 19),  # bool() concretization (same line)
         ("R2", 26),  # .item() in the decorated root
         ("R2", 31),  # float() in a jax.jit(partial(...))-assigned root
+        ("R2", 69),  # np.asarray in a lambda-reached kernel nested def
+        ("R2", 76),  # np.asarray in a pl.when-decorated `def _():`
+        ("R2", 80),  # ... and in the SECOND `def _():` (qualname dedup)
     ]
 
 
@@ -84,8 +87,24 @@ def test_r2_exempts_guards_statics_and_host_code():
         [FIXTURES / "r2_jit_host_sync.py"], [JitHostSyncRule()]
     )
     flagged = {f.line for f in findings}
-    # guarded() (is_concrete region), never_traced(), static_ok() clean.
-    assert all(line <= 31 for line in flagged)
+    # guarded() (is_concrete region), never_traced(), static_ok() clean
+    # (lines 33-58; the fused-PSQT kernel fixture follows after).
+    assert not any(33 <= line <= 58 for line in flagged)
+
+
+def test_r2_reaches_fused_psqt_kernel_paths():
+    """The fused-PSQT pallas_call entry point's kernel regions are in
+    R2's call graph: host syncs inside a nested def reached only through
+    a lambda argument, inside a `@pl.when`-decorated `def _():`, and
+    inside a SECOND same-named `def _():` (engine qualname dedup) are
+    all flagged and blamed on the kernel root."""
+    findings = check_paths(
+        [FIXTURES / "r2_jit_host_sync.py"], [JitHostSyncRule()]
+    )
+    by_line = {f.line: f for f in findings}
+    for line in (69, 76, 80):
+        assert line in by_line, f"fused-PSQT violation at {line} not flagged"
+        assert "_psqt_kernel" in by_line[line].message
 
 
 # -- R3 -------------------------------------------------------------------

@@ -91,6 +91,53 @@ def test_expansion_counts_and_skips(seed, plies, skip_data):
         assert list(p.moves) == moves[:i]
 
 
+@settings(max_examples=6, deadline=None)
+@given(seed=st.integers(0, 2**16))
+def test_fused_psqt_parity_property(seed):
+    """Property pin of the ABI 9 device-PSQT contract: on RANDOM batch
+    compositions (plain fulls, anchor seeds, persistent anchor deltas
+    with swap, in-batch deltas, removal encodings), the fused kernel's
+    PSQT accumulator (interpreter mode) is bit-identical to the XLA
+    path and to an independent numpy chain walk — the same three-way
+    agreement the deterministic test pins, over the composition space."""
+    import numpy as np
+
+    jnp = pytest.importorskip("jax.numpy")
+    from test_ops import build_psqt_parity_batch, np_resolve_psqt
+
+    from fishnet_tpu.ops.ft_gather import ft_accumulate
+
+    n_features, l1, active = 64, 1024, 32
+    rng = np.random.default_rng(seed)
+    ft_w = np.vstack(
+        [rng.integers(-50, 50, (n_features, l1)), np.zeros((1, l1))]
+    ).astype(np.int16)
+    ft_b = rng.integers(-20, 20, (l1,)).astype(np.int16)
+    psqt_rows = np.vstack(
+        [rng.integers(-3000, 3000, (n_features, 8)), np.zeros((1, 8))]
+    ).astype(np.int32)
+    idx, parent, delta_base = build_psqt_parity_batch(
+        n_features, active, rng, n_blocks=3, block=3, n_tab=4
+    )
+    tab = rng.integers(-5000, 5000, (4, 2, l1)).astype(np.int32)
+    ptab = rng.integers(-4000, 4000, (4, 2, 8)).astype(np.int32)
+    args = dict(delta_base=delta_base, parent=jnp.asarray(parent),
+                anchor_tab=jnp.asarray(tab), ft_psqt=jnp.asarray(psqt_rows),
+                psqt_tab=jnp.asarray(ptab))
+    acc_x, psqt_x = ft_accumulate(
+        jnp.asarray(ft_w), jnp.asarray(ft_b), jnp.asarray(idx),
+        use_pallas=False, **args,
+    )
+    acc_f, psqt_f = ft_accumulate(
+        jnp.asarray(ft_w), jnp.asarray(ft_b), jnp.asarray(idx),
+        interpret=True, **args,
+    )
+    assert np.array_equal(np.asarray(acc_x), np.asarray(acc_f))
+    assert np.array_equal(np.asarray(psqt_x), np.asarray(psqt_f))
+    ref = np_resolve_psqt(idx, parent, psqt_rows, ptab, delta_base)
+    assert np.array_equal(np.asarray(psqt_x).astype(np.int64), ref)
+
+
 @settings(max_examples=20, deadline=None)
 @given(seed=st.integers(0, 10_000), plies=st.integers(1, 16))
 def test_reassembly_order_independent(seed, plies):

@@ -315,7 +315,8 @@ def test_evaluate_packed_anchored_offsets_and_store():
     full, 1 per delta; padding clamps into the tier-end sentinel
     block), returns values identical to the explicit-offsets packed
     path, and scatters anchor entries' resolved accumulators into
-    their table rows."""
+    their table rows — the PSQT table included (ABI 9 device-PSQT
+    wire: material=None)."""
     from fishnet_tpu.nnue import spec
     from fishnet_tpu.nnue.jax_eval import (
         evaluate_packed,
@@ -363,13 +364,18 @@ def test_evaluate_packed_anchored_offsets_and_store():
     buckets = rng.integers(0, 8, (B,)).astype(np.int32)
     material = rng.integers(-400, 400, (B,)).astype(np.int32)
     tab = rng.integers(-3000, 3000, (A, 2, spec.L1)).astype(np.int32)
+    ptab = rng.integers(-2000, 2000, (A, 2, spec.NUM_PSQT_BUCKETS)).astype(
+        np.int32
+    )
 
-    vals, new_tab = evaluate_packed_anchored(
+    vals, new_tab, new_ptab = evaluate_packed_anchored(
         params, jnp.asarray(packed), jnp.asarray(buckets),
         jnp.asarray(parent), jnp.asarray(material), jnp.asarray(tab),
-        jnp.asarray(np.array([rows], np.int32)),
+        jnp.asarray(np.array([rows], np.int32)), jnp.asarray(ptab),
     )
     vals, new_tab = np.asarray(vals), np.asarray(new_tab)
+    # Host-material mode: the PSQT table rides through untouched.
+    assert np.array_equal(np.asarray(new_ptab), ptab)
 
     # Table-independent entries check against the explicit-offsets
     # packed path (persistent codes stripped to their wire-equivalent
@@ -415,6 +421,275 @@ def test_evaluate_packed_anchored_offsets_and_store():
     assert not np.array_equal(new_tab[3], tab[3])
     assert np.array_equal(new_tab[1], tab[1])
     assert np.array_equal(new_tab[2], tab[2])
+
+    # DEVICE-PSQT wire (material=None): the fused pass resolves PSQT
+    # against ptab, the head selects the bucket itself, and anchor
+    # entries' resolved PSQT accumulators scatter into their rows.
+    vals_d, _, new_ptab_d = evaluate_packed_anchored(
+        params, jnp.asarray(packed), jnp.asarray(buckets),
+        jnp.asarray(parent), None, jnp.asarray(tab),
+        jnp.asarray(np.array([rows], np.int32)), jnp.asarray(ptab),
+    )
+    vals_d, new_ptab_d = np.asarray(vals_d), np.asarray(new_ptab_d)
+    psqt = np.asarray(
+        ft_accumulate(
+            params["ft_w"], params["ft_b"], dense, use_pallas=False,
+            delta_base=spec.DELTA_BASE, parent=jnp.asarray(parent),
+            anchor_tab=jnp.asarray(tab), ft_psqt=params["ft_psqt"],
+            psqt_tab=jnp.asarray(ptab),
+        )[1]
+    )
+    sel = psqt[np.arange(B), :, buckets]
+    d = sel[:, 0].astype(np.int64) - sel[:, 1]
+    mat = np.where(d >= 0, d // 2, -((-d) // 2))  # C truncation
+    ref_d = np.asarray(
+        _evaluate_from_acc(
+            params, acc, dense, jnp.asarray(buckets), jnp.asarray(parent),
+            jnp.asarray(mat.astype(np.int32)),
+        )
+    )
+    assert np.array_equal(vals_d[:real], ref_d[:real])
+    assert not np.array_equal(new_ptab_d[0], ptab[0])
+    assert not np.array_equal(new_ptab_d[3], ptab[3])
+    assert np.array_equal(new_ptab_d[1], ptab[1])
+    assert np.array_equal(new_ptab_d[2], ptab[2])
+    # The stored PSQT rows ARE the resolved accumulators.
+    assert np.array_equal(new_ptab_d[0], psqt[0])
+    assert np.array_equal(new_ptab_d[3], psqt[2])
+
+
+def build_psqt_parity_batch(n_features, active, rng, n_blocks=6, block=4,
+                            n_tab=8):
+    """Batch covering EVERY wire entry kind the PSQT path must resolve:
+    plain fulls (-1), anchor full (re)seeds, persistent anchor deltas
+    (with swap), in-batch deltas (with swap), removal encodings
+    (DELTA_BASE + f), and the per-region sentinel padding. In-batch refs
+    always point at the most recent preceding anchor entry (the pool's
+    emit contract, which the kernel's running anchor depends on)."""
+    from fishnet_tpu.ops.ft_gather import _DELTA_SLOTS
+
+    delta_base = n_features + 1
+    batch = n_blocks * block
+    idx = np.full((batch, 2, active), n_features, np.int32)
+    parent = np.full((batch,), -1, np.int32)
+
+    def fill_full(e):
+        idx[e, :, : active - 3] = rng.integers(0, n_features, (2, active - 3))
+
+    def fill_delta(e):
+        idx[e] = n_features
+        for p in range(2):
+            n_add = int(rng.integers(0, _DELTA_SLOTS + 1))
+            n_rem = int(rng.integers(0, _DELTA_SLOTS + 1))
+            idx[e, p, :n_add] = rng.integers(0, n_features, n_add)
+            idx[e, p, _DELTA_SLOTS : _DELTA_SLOTS + n_rem] = (
+                delta_base + rng.integers(0, n_features, n_rem)
+            )
+            idx[e, p, _DELTA_SLOTS + n_rem : 2 * _DELTA_SLOTS] = (
+                delta_base + n_features
+            )
+
+    for k, s in enumerate(range(0, batch, block)):
+        kind = k % 3
+        if kind == 0 and k > 0:  # plain full (entry 0 stays an anchor)
+            fill_full(s)
+        elif kind == 2 and k > 0:  # persistent anchor delta (load+store)
+            parent[s] = _pers_code(k % n_tab, True, swap=int(rng.integers(0, 2)))
+            fill_delta(s)
+        else:  # anchor full (re)seed
+            parent[s] = _pers_code(k % n_tab, False)
+            fill_full(s)
+        for j in range(1, block):
+            e = s + j
+            parent[e] = (s << 1) | int(rng.integers(0, 2))
+            fill_delta(e)
+    return idx, parent, delta_base
+
+
+def np_resolve_psqt(idx, parent, psqt_rows, ptab, delta_base):
+    """Independent numpy reconstruction of the resolved PSQT accumulator
+    stream — the same walk cpp/src/pool.cpp fill_full/fill_delta does
+    host-side (explicit chains, no kernel machinery). int64 to prove no
+    intermediate overflow hides in the int32 paths."""
+    B = idx.shape[0]
+    nb = psqt_rows.shape[1]
+    rows64 = psqt_rows.astype(np.int64)
+    out = np.zeros((B, 2, nb), np.int64)
+    for b in range(B):
+        code = int(parent[b])
+        v = -code - 2
+        is_delta = code >= 0 or (code <= -2 and (v & 2) != 0)
+        if code >= 0:
+            base, swap = out[int(code) >> 1].copy(), code & 1
+        elif code <= -2 and (v & 2) != 0:
+            base, swap = ptab[v >> 2].astype(np.int64).copy(), v & 1
+        else:
+            base, swap = np.zeros((2, nb), np.int64), 0
+        if swap:
+            base = base[::-1]
+        acc = base if is_delta else np.zeros((2, nb), np.int64)
+        for p in range(2):
+            for f in idx[b, p]:
+                f = int(f)
+                if f >= delta_base:
+                    acc[p] -= rows64[f - delta_base]
+                else:
+                    acc[p] += rows64[f]
+        out[b] = acc
+    return out
+
+
+def host_material_np(psqt, buckets):
+    """The pool's host-side material term from a resolved [B, 2, 8] PSQT
+    accumulator: bucket select, (stm - opp) / 2 with C truncation."""
+    sel = psqt[np.arange(len(buckets)), :, buckets].astype(np.int64)
+    d = sel[:, 0] - sel[:, 1]
+    return np.where(d >= 0, d // 2, -((-d) // 2)).astype(np.int32)
+
+
+def test_fused_psqt_parity_all_entry_kinds(monkeypatch):
+    """Satellite parity pin: the fused kernel's PSQT accumulator is
+    bit-identical to the XLA path, to an independent numpy chain walk
+    (the host material recomputation), and both material routes produce
+    identical SCORES — across plain fulls, in-batch deltas with swap,
+    removal encodings, and persistent anchor store/load codes, with
+    chunk boundaries straddled (_CHUNK shrunk so carries engage)."""
+    from fishnet_tpu.nnue import spec
+    from fishnet_tpu.nnue.jax_eval import (
+        _evaluate_from_acc,
+        params_from_weights,
+    )
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.ops import ft_gather
+
+    # _CHUNK=6 against blocks of 4: the 4..7 block's children straddle
+    # the first chunk boundary (carry-in engages) and the 12..15 block's
+    # persistent head lands exactly ON a boundary.
+    # active=16 halves the kernel's unrolled transfer trace (the test's
+    # cost is trace-bound); the full-spec oracle test below keeps the
+    # 32-slot shape covered.
+    monkeypatch.setattr(ft_gather, "_CHUNK", 6)
+    n_features, l1, active = 512, 1024, 16
+    rng = np.random.default_rng(77)
+    ft_w = np.vstack(
+        [rng.integers(-200, 200, (n_features, l1)), np.zeros((1, l1))]
+    ).astype(np.int16)
+    ft_b = rng.integers(-100, 100, (l1,)).astype(np.int16)
+    psqt_rows = np.vstack(
+        [rng.integers(-3000, 3000, (n_features, 8)), np.zeros((1, 8))]
+    ).astype(np.int32)
+    idx, parent, delta_base = build_psqt_parity_batch(
+        n_features, active, rng, n_blocks=4, block=4
+    )
+    B = len(parent)
+    tab = rng.integers(-5000, 5000, (8, 2, l1)).astype(np.int32)
+    ptab = rng.integers(-4000, 4000, (8, 2, 8)).astype(np.int32)
+
+    args = dict(delta_base=delta_base, parent=jnp.asarray(parent),
+                anchor_tab=jnp.asarray(tab), ft_psqt=jnp.asarray(psqt_rows),
+                psqt_tab=jnp.asarray(ptab))
+    acc_x, psqt_x = ft_gather.ft_accumulate(
+        jnp.asarray(ft_w), jnp.asarray(ft_b), jnp.asarray(idx),
+        use_pallas=False, **args,
+    )
+    acc_f, psqt_f = ft_gather.ft_accumulate(
+        jnp.asarray(ft_w), jnp.asarray(ft_b), jnp.asarray(idx),
+        interpret=True, **args,
+    )
+    acc_x, psqt_x = np.asarray(acc_x), np.asarray(psqt_x)
+    acc_f, psqt_f = np.asarray(acc_f), np.asarray(psqt_f)
+    # Fused == XLA, accumulators and PSQT alike, bit for bit.
+    assert np.array_equal(acc_x, acc_f)
+    assert np.array_equal(psqt_x, psqt_f)
+    # == the independent host chain walk (no int32 overflow hid either).
+    ref = np_resolve_psqt(idx, parent, psqt_rows, ptab, delta_base)
+    assert np.array_equal(psqt_x.astype(np.int64), ref)
+
+    # Host-material wire vs device-PSQT wire: identical SCORES.
+    params = params_from_weights(NnueWeights.random(seed=5))
+    buckets = rng.integers(0, spec.NUM_PSQT_BUCKETS, (B,)).astype(np.int32)
+    material = host_material_np(psqt_x, buckets)
+    via_host = np.asarray(_evaluate_from_acc(
+        params, jnp.asarray(acc_x), jnp.asarray(idx), jnp.asarray(buckets),
+        jnp.asarray(parent), jnp.asarray(material),
+    ))
+    via_device = np.asarray(_evaluate_from_acc(
+        params, jnp.asarray(acc_f), jnp.asarray(idx), jnp.asarray(buckets),
+        jnp.asarray(parent), None, psqt=jnp.asarray(psqt_f),
+    ))
+    assert np.array_equal(via_host, via_device)
+
+
+def test_device_psqt_score_parity_with_cpp_oracle(tmp_path):
+    """Full-spec four-way parity on REAL positions: the C++ scalar
+    oracle, the host-material wire, the XLA device-PSQT path, and the
+    fused kernel (interpreter mode) agree bit for bit on the final
+    centipawn scores."""
+    import random
+
+    from fishnet_tpu.chess import Board
+    from fishnet_tpu.nnue import spec
+    from fishnet_tpu.nnue.cpp_oracle import CppNnue
+    from fishnet_tpu.nnue.jax_eval import (
+        _evaluate_from_acc,
+        evaluate_batch,
+        params_from_weights,
+    )
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.ops.ft_gather import ft_accumulate
+
+    weights = NnueWeights.random(seed=7)
+    net = tmp_path / "parity.nnue"
+    weights.save(net)
+    oracle = CppNnue(net)
+
+    random.seed(99)
+    boards = []
+    while len(boards) < 12:
+        b = Board()
+        for _ in range(random.randrange(4, 70)):
+            if b.outcome() != 0:
+                break
+            b.push_uci(random.choice(b.legal_moves()))
+        boards.append(b)
+
+    idx = np.stack([b.nnue_features()[0] for b in boards]).astype(np.int32)
+    buckets = np.array(
+        [b.nnue_features()[1] for b in boards], dtype=np.int32
+    )
+    params = params_from_weights(weights)
+
+    cpp = np.array([oracle.evaluate(b) for b in boards], dtype=np.int32)
+
+    # Host material, recomputed the way cpp fill_full walks ft_psqt.
+    psqt_acc = np.zeros((len(boards), 2, spec.NUM_PSQT_BUCKETS), np.int64)
+    for i in range(len(boards)):
+        for p in range(2):
+            for f in idx[i, p]:
+                if f < spec.NUM_FEATURES:
+                    psqt_acc[i, p] += weights.ft_psqt[f]
+    material = host_material_np(psqt_acc, buckets)
+    via_host = np.asarray(evaluate_batch(
+        params, jnp.asarray(idx), jnp.asarray(buckets),
+        material=jnp.asarray(material),
+    ))
+    # Device PSQT, XLA path (material=None routes through the same
+    # fused-pass code with the XLA executor on CPU).
+    via_xla = np.asarray(
+        evaluate_batch(params, jnp.asarray(idx), jnp.asarray(buckets))
+    )
+    # Device PSQT, fused kernel in interpreter mode.
+    acc, psqt = ft_accumulate(
+        params["ft_w"], params["ft_b"], jnp.asarray(idx),
+        interpret=True, ft_psqt=params["ft_psqt"],
+    )
+    via_fused = np.asarray(_evaluate_from_acc(
+        params, acc, jnp.asarray(idx), jnp.asarray(buckets), None, None,
+        psqt=psqt,
+    ))
+    assert np.array_equal(cpp, via_host)
+    assert np.array_equal(cpp, via_xla)
+    assert np.array_equal(cpp, via_fused)
 
 
 def test_decode_parent_masks_swap_for_plain_fulls():

@@ -58,6 +58,46 @@ def test_min_user_backlog_scales_with_speed():
     assert fast.min_user_backlog() == 0.0
 
 
+def test_systemd_unit_user_fallback(monkeypatch):
+    """User= in the generated system unit: $USER when set, the passwd
+    account name when not, and never a literal placeholder (a unit with
+    `User=XXX` fails at systemctl start)."""
+    import getpass
+
+    from fishnet_tpu import systemd
+
+    monkeypatch.setenv("USER", "alice")
+    assert systemd._unit_user() == "alice"
+
+    monkeypatch.delenv("USER", raising=False)
+    monkeypatch.setattr(getpass, "getuser", lambda: "realuser")
+    assert systemd._unit_user() == "realuser"
+
+    def no_entry():
+        raise KeyError("uid has no passwd entry")
+
+    monkeypatch.setattr(getpass, "getuser", no_entry)
+    assert systemd._unit_user() == "nobody"
+
+
+def test_systemd_unit_never_emits_placeholder(monkeypatch):
+    import io
+
+    from fishnet_tpu import configure as cfg
+    from fishnet_tpu import systemd
+
+    monkeypatch.delenv("USER", raising=False)
+    out = io.StringIO()
+    systemd.systemd_system(cfg.Opt(command="systemd", no_conf=True), out)
+    user_lines = [
+        line for line in out.getvalue().splitlines()
+        if line.startswith("User=")
+    ]
+    assert len(user_lines) == 1
+    assert user_lines[0] != "User=XXX"
+    assert len(user_lines[0]) > len("User=")
+
+
 def test_queue_status_bar():
     bar = str(QueueStatusBar(pending=10, cores=4))
     assert bar.startswith("[") and "10" in bar

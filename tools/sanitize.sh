@@ -8,9 +8,11 @@
 #   SANITIZE_NET=path/to/net.nnue tools/sanitize.sh
 #
 # A net is what arms the NNUE half of the stress traffic AND the
-# persistent-anchor provide-guard unit phase; without one (and without a
-# Python able to synthesize one) the run covers HCE/variant traffic
-# only, and says so.
+# persistent-anchor unit phases (the full-provide guard plus the ABI 9
+# anchors+PSQT wire cross-check, which also exercises the optional
+# out_material=nullptr layout); without one (and without a Python able
+# to synthesize one) the run covers HCE/variant traffic only, and says
+# so.
 #
 # See doc/static-analysis.md for what each sanitizer is expected to
 # catch in this codebase.
