@@ -4,11 +4,17 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
 	$(PYTHON) -m fishnet_tpu.analysis
+
+# Telemetry contract (doc/observability.md): start the exporter on an
+# ephemeral port, scrape /metrics, validate exposition syntax and the
+# contract families, span dumps, net/api outcome counters.
+metrics-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_telemetry.py -q
 
 # ASan+UBSan pool stress incl. the anchor full-provide guard case —
 # the non-tier-1 `slow` job.

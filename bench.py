@@ -791,6 +791,20 @@ def main() -> None:
     from fishnet_tpu.nnue.weights import NnueWeights
     from fishnet_tpu.search.service import SearchService
 
+    # Live telemetry during bench (FISHNET_METRICS_PORT=port, 0 =
+    # ephemeral): the SearchService below registers the same collectors
+    # serving does, so offline bench and live serving report through
+    # identical metric names — scrape /metrics mid-window to watch
+    # occupancy/wire counters move. Left open until process exit (the
+    # exporter thread is a daemon).
+    _metrics_port = _os.environ.get("FISHNET_METRICS_PORT")
+    if _metrics_port is not None:
+        from fishnet_tpu import telemetry
+
+        _exporter = telemetry.start_exporter(int(_metrics_port))
+        log(f"bench: serving telemetry on http://127.0.0.1:{_exporter.port}"
+            "/metrics (SIGUSR2 dumps the span flight recorder)")
+
     params = device_params()
     log("bench: probing tunnel transport...")
     transport = probe_transport(params)

@@ -1,22 +1,131 @@
-// Minimal stackful-coroutine wrapper over ucontext, used to suspend an
-// alpha-beta search at each leaf evaluation so thousands of searches can
-// share one TPU eval microbatch.
+// Minimal stackful-coroutine wrapper, used to suspend an alpha-beta
+// search at each leaf evaluation so thousands of searches can share one
+// TPU eval microbatch.
 //
 // This replaces the reference's parallelism unit: where fishnet runs one
 // blocking single-threaded engine *process* per core (src/main.rs:158-170),
 // fishnet-tpu runs thousands of cooperative search fibers per host thread,
 // all yielding leaf positions into a shared evaluator batch (SURVEY.md §7
 // "the inversion that makes this TPU-shaped").
+//
+// Two backends behind one interface:
+//  * POSIX: ucontext contexts over an mmap'd stack with a PROT_NONE
+//    guard page (Linux, macOS);
+//  * Windows: the Win32 Fiber API (CreateFiberEx/SwitchToFiber), which
+//    is the same shape — the OS manages the stack, reserves the full
+//    size, commits pages on touch, and places its own guard page.
 
 #pragma once
-
-#include <sys/mman.h>
-#include <ucontext.h>
-#include <unistd.h>
 
 #include <cstdint>
 #include <cstdlib>
 #include <functional>
+
+#ifdef _WIN32
+
+#ifndef WIN32_LEAN_AND_MEAN
+#define WIN32_LEAN_AND_MEAN
+#endif
+#ifndef NOMINMAX
+#define NOMINMAX
+#endif
+#include <windows.h>
+
+namespace fc {
+
+class Fiber {
+ public:
+  // Reserve the full stack, commit one page up front; the kernel grows
+  // it through its guard page exactly like a thread stack, so overflow
+  // faults instead of corrupting neighboring slots (the same contract
+  // the POSIX backend gets from its explicit PROT_NONE page).
+  explicit Fiber(size_t stack_size = 512 * 1024) : stack_size_(stack_size) {
+    fiber_ = CreateFiberEx(4096, stack_size_, 0, &Fiber::trampoline, this);
+  }
+
+  ~Fiber() {
+    if (fiber_) DeleteFiber(fiber_);
+  }
+
+  bool valid() const { return fiber_ != nullptr; }
+
+  Fiber(const Fiber&) = delete;
+  Fiber& operator=(const Fiber&) = delete;
+
+  // Start running fn on this fiber. fn runs until it yields or returns.
+  void start(std::function<void()> fn) {
+    fn_ = std::move(fn);
+    done_ = false;
+    // A finished fiber's entry point has returned control and cannot be
+    // re-entered: recreate it so the trampoline starts fresh.
+    if (started_) {
+      if (fiber_) DeleteFiber(fiber_);
+      fiber_ = CreateFiberEx(4096, stack_size_, 0, &Fiber::trampoline, this);
+      if (!fiber_) {
+        done_ = true;
+        return;
+      }
+    }
+    started_ = true;
+    resume();
+  }
+
+  // Resume the fiber until its next yield() or completion.
+  void resume() {
+    void*& sched = scheduler_fiber();
+    if (!sched) {
+      // First resume on this thread: the scheduler must itself be a
+      // fiber before SwitchToFiber can leave it.
+      sched = IsThreadAFiber() ? GetCurrentFiber()
+                               : ConvertThreadToFiber(nullptr);
+    }
+    caller_ = sched;
+    current_ = this;
+    SwitchToFiber(fiber_);
+    current_ = nullptr;
+  }
+
+  // Called from inside the fiber: return control to the scheduler.
+  void yield() { SwitchToFiber(caller_); }
+
+  bool done() const { return done_; }
+
+  // The fiber currently executing on this thread (nullptr outside fibers).
+  static Fiber* current() { return current_; }
+
+ private:
+  static void CALLBACK trampoline(void* p) {
+    Fiber* self = static_cast<Fiber*>(p);
+    self->fn_();
+    self->done_ = true;
+    // A fiber procedure must never return (it would exit the thread);
+    // hand control back to the scheduler, like uc_link does on POSIX.
+    SwitchToFiber(self->caller_);
+  }
+
+  static void*& scheduler_fiber() {
+    static thread_local void* f = nullptr;
+    return f;
+  }
+
+  void* fiber_ = nullptr;
+  void* caller_ = nullptr;
+  size_t stack_size_;
+  bool started_ = false;
+  std::function<void()> fn_;
+  bool done_ = true;
+  static thread_local Fiber* current_;
+};
+
+inline thread_local Fiber* Fiber::current_ = nullptr;
+
+}  // namespace fc
+
+#else  // POSIX ucontext backend
+
+#include <sys/mman.h>
+#include <ucontext.h>
+#include <unistd.h>
 
 // macOS has no MAP_STACK (Linux uses it as a hint for stack mappings;
 // omitting it is semantically fine everywhere).
@@ -105,3 +214,5 @@ class Fiber {
 inline thread_local Fiber* Fiber::current_ = nullptr;
 
 }  // namespace fc
+
+#endif  // _WIN32

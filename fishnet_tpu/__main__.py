@@ -253,6 +253,21 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         no_stats_file=opt.no_stats_file,
     )
 
+    # Live telemetry (opt-in via --metrics-port / MetricsPort ini key):
+    # /metrics + /json on an http.server thread, span recording in the
+    # pipeline hot paths, SIGUSR2 armed to dump the flight recorder.
+    exporter = None
+    if opt.metrics_port is not None:
+        from fishnet_tpu import telemetry
+        from fishnet_tpu.utils.stats import register_stats_collector
+
+        exporter = telemetry.start_exporter(opt.metrics_port)
+        register_stats_collector(stats)
+        logger.info(
+            f"Serving telemetry on http://127.0.0.1:{exporter.port}/metrics "
+            "(SIGUSR2 dumps the span flight recorder)."
+        )
+
     engine_factory = build_engine_factory(opt, logger)
     client = Client(
         endpoint=opt.resolved_endpoint(),
@@ -346,6 +361,11 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         # daemon driver thread still inside native/JAX code when Python
         # unwinds takes the process down with SIGABRT.
         engine_factory.close()
+        # Flush the (interval-debounced) stats file and stop serving
+        # scrapes before teardown completes.
+        stats.flush()
+        if exporter is not None:
+            exporter.close()
         logger.fishnet_info(client.stats_summary())
     # Promote + restart only on a clean drain with no operator stop
     # intent: a second ^C / SIGTERM (stop) or even a single ^C (drain

@@ -203,6 +203,10 @@ class Opt:
     #: eval batch whenever >1 device is visible), "off" (single device),
     #: or an explicit "DATAxMODEL" shape such as "4x2".
     mesh: Optional[str] = None
+    #: Telemetry exposition port (doc/observability.md). None = telemetry
+    #: off (the default; hot paths pay one flag check); 0 = an ephemeral
+    #: port (logged at startup); otherwise the port /metrics binds on.
+    metrics_port: Optional[int] = None
 
     def conf_path(self) -> Path:
         return Path(self.conf) if self.conf else Path("fishnet.ini")
@@ -293,6 +297,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Device mesh for the serving evaluator: auto (default; "
                         "shard eval batches over all visible devices), off "
                         "(single device), or DATAxMODEL (e.g. 4x2).")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="Serve live telemetry (/metrics Prometheus text, "
+                        "/json snapshot) on this port and arm the SIGUSR2 "
+                        "span-dump. 0 picks an ephemeral port. Default: "
+                        "telemetry off.")
     return p
 
 
@@ -340,7 +349,19 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
         opt.search_concurrency = ns.search_concurrency
     if ns.mesh is not None:
         opt.mesh = parse_mesh(ns.mesh)
+    if ns.metrics_port is not None:
+        opt.metrics_port = _parse_port(str(ns.metrics_port))
     return opt
+
+
+def _parse_port(value: str) -> int:
+    try:
+        port = int(value)
+    except ValueError as err:
+        raise ConfigError(f"invalid port: {value!r}") from err
+    if not 0 <= port <= 65535:
+        raise ConfigError("metrics port must be in 0..65535 (0 = ephemeral)")
+    return port
 
 
 # ---------------------------------------------------------------------------
@@ -363,6 +384,7 @@ _INI_FIELDS = (
     ("SearchThreads", "search_threads", lambda v: _positive_int(v, "SearchThreads")),
     ("SearchConcurrency", "search_concurrency",
      lambda v: _positive_int(v, "SearchConcurrency")),
+    ("MetricsPort", "metrics_port", lambda v: _parse_port(v)),
 )
 
 

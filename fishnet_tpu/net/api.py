@@ -15,11 +15,14 @@ mirrors the reference's mpsc/oneshot channels.
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 import aiohttp
 
+from fishnet_tpu import telemetry as _telemetry
+from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 from fishnet_tpu.protocol.types import (
     Acquired,
     AcquireResponseBody,
@@ -37,6 +40,37 @@ from fishnet_tpu.version import PROTOCOL_VERSION, user_agent
 
 REQUEST_TIMEOUT_SECONDS = 30.0  # api.rs:527
 POOL_IDLE_TIMEOUT_SECONDS = 25.0  # api.rs:528
+
+# Server-traffic telemetry (doc/observability.md). Recorded
+# unconditionally: one histogram observe + one counter inc per HTTP
+# round trip is noise next to the request itself, and the instruments'
+# per-thread cells take no shared lock. ``endpoint`` is the message
+# kind (acquire / submit_analysis / submit_move / abort / status /
+# check_key); ``outcome`` is ok / rate_limited / error.
+_REQUEST_SECONDS = _telemetry.REGISTRY.histogram(
+    "fishnet_api_request_seconds",
+    "Server round-trip latency per endpoint.",
+    labelnames=("endpoint",),
+)
+_REQUESTS = _telemetry.REGISTRY.counter(
+    "fishnet_api_requests_total",
+    "Completed server requests per endpoint and outcome.",
+    labelnames=("endpoint", "outcome"),
+)
+_REJECTS = _telemetry.REGISTRY.counter(
+    "fishnet_api_rejected_total",
+    "Acquire-path rejections (HTTP 400/401/403/406): the server "
+    "refused this client and the queue will stop.",
+    labelnames=("endpoint", "status"),
+)
+_SUSPENSIONS = _telemetry.REGISTRY.counter(
+    "fishnet_api_suspensions_total",
+    "429 responses that suspended ALL server traffic.",
+)
+_SUSPENDED_SECONDS = _telemetry.REGISTRY.counter(
+    "fishnet_api_suspended_seconds_total",
+    "Cumulative seconds of 429-imposed traffic suspension.",
+)
 
 
 class KeyError_(Exception):
@@ -164,13 +198,26 @@ class ApiActor:
             self.logger.debug("Api actor exited")
 
     async def _handle(self, msg: _Message) -> None:
+        started = time.monotonic()
         try:
             await self._handle_inner(msg)
+            _REQUEST_SECONDS.observe(
+                time.monotonic() - started, endpoint=msg.kind
+            )
+            _REQUESTS.inc(endpoint=msg.kind, outcome="ok")
+            if msg.kind == "acquire" and _telemetry.enabled():
+                _SPANS.record("acquire", started)
             self.error_backoff.reset()
         except asyncio.CancelledError:
             raise
         except RateLimited:
+            _REQUEST_SECONDS.observe(
+                time.monotonic() - started, endpoint=msg.kind
+            )
+            _REQUESTS.inc(endpoint=msg.kind, outcome="rate_limited")
             backoff = 60.0 + self.error_backoff.next()
+            _SUSPENSIONS.inc()
+            _SUSPENDED_SECONDS.inc(backoff)
             self.logger.error(
                 f"Too many requests. Suspending requests for {backoff:.1f}s."
             )
@@ -178,6 +225,10 @@ class ApiActor:
                 msg.future.set_exception(RateLimited())
             await asyncio.sleep(backoff)
         except Exception as err:  # noqa: BLE001 - any transport/protocol error
+            _REQUEST_SECONDS.observe(
+                time.monotonic() - started, endpoint=msg.kind
+            )
+            _REQUESTS.inc(endpoint=msg.kind, outcome="error")
             backoff = self.error_backoff.next()
             self.logger.error(f"{err!r}. Backing off {backoff:.1f}s.")
             if msg.future and not msg.future.done():
@@ -203,6 +254,7 @@ class ApiActor:
             self._fulfil(msg, Acquired.no_content())
         elif res.status in (400, 401, 403, 406):
             text = await res.text()
+            _REJECTS.inc(endpoint=msg.kind, status=str(res.status))
             self.logger.error(f"Server rejected request: {text}")
             self._fulfil(msg, Acquired.rejected())
         elif res.status in (200, 202):

@@ -24,11 +24,14 @@ from __future__ import annotations
 
 import asyncio
 import time
+import weakref
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional, Tuple
 
+from fishnet_tpu import telemetry as _telemetry
 from fishnet_tpu.chess import Board, InvalidFenError, UnsupportedVariantError
+from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 from fishnet_tpu.ipc import Position, PositionFailed, PositionResponse
 from fishnet_tpu.net.api import ApiStub
 from fishnet_tpu.protocol.types import (
@@ -238,6 +241,52 @@ class CompletedBatch:
 # ---------------------------------------------------------------------------
 # Queue state shared between stub and actor
 # ---------------------------------------------------------------------------
+
+
+def _register_queue_collector(state: "QueueState") -> int:
+    """Scheduler-depth metrics (doc/observability.md), pulled at scrape
+    time from the live QueueState: positions in flight, batch count,
+    incoming queue depth, and backlog seconds (age of the oldest pending
+    batch). Holds only a weakref so a finished client's state is
+    collectable; reads are snapshot-copied (exporter thread vs event
+    loop) and never mutate."""
+    ref = weakref.ref(state)
+
+    def collect():
+        st = ref()
+        if st is None:
+            return None
+        batches = list(st.pending.values())
+        oldest = min((b.started_at for b in batches), default=None)
+        backlog = 0.0 if oldest is None else max(
+            0.0, time.monotonic() - oldest
+        )
+        return [
+            _telemetry.gauge_family(
+                "fishnet_queue_pending_positions",
+                "Positions assigned to workers but not yet analysed.",
+                sum(b.pending() for b in batches),
+            ),
+            _telemetry.gauge_family(
+                "fishnet_queue_pending_batches",
+                "Acquired batches not yet fully analysed.", len(batches),
+            ),
+            _telemetry.gauge_family(
+                "fishnet_queue_incoming_positions",
+                "Positions queued for worker pull.", len(st.incoming),
+            ),
+            _telemetry.gauge_family(
+                "fishnet_queue_backlog_seconds",
+                "Age of the oldest pending batch.", backlog,
+            ),
+            _telemetry.gauge_family(
+                "fishnet_queue_move_submissions",
+                "Completed move jobs awaiting submission.",
+                len(st.move_submissions),
+            ),
+        ]
+
+    return _telemetry.REGISTRY.register_collector(collect, name="queue")
 
 
 class QueueState:
@@ -483,6 +532,10 @@ class QueueActor:
 
     async def handle_acquired(self, body: AcquireResponseBody) -> None:
         context = body.work.id
+        # "schedule" span: trust-boundary replay + per-ply expansion +
+        # enqueue — the stage between acquire and the search pipeline.
+        tel = _telemetry.enabled()
+        t0 = time.monotonic() if tel else 0.0
         try:
             incoming = IncomingBatch.from_acquired(self.api.endpoint, body)
         except AllSkipped as all_skipped:
@@ -493,11 +546,22 @@ class QueueActor:
                 completed.flavor.eval_flavor(),
                 completed.into_analysis(),
             )
+            if tel:
+                _SPANS.record(
+                    "schedule", t0, batch=context, outcome="all_skipped"
+                )
             return
         except IncomingError as err:
             self.logger.warn(f"Ignoring invalid batch {context}: {err}")
+            if tel:
+                _SPANS.record("schedule", t0, batch=context, outcome="invalid")
             return
         self.state.add_incoming_batch(incoming)
+        if tel:
+            _SPANS.record(
+                "schedule", t0, batch=context, outcome="accepted",
+                positions=len(incoming.positions),
+            )
 
     async def handle_move_submissions(self) -> None:
         while True:
@@ -600,6 +664,7 @@ def channel(
     state = QueueState(
         cores, stats or StatsRecorder(cores, no_stats_file=True), logger
     )
+    _register_queue_collector(state)
     stub = QueueStub(rx, interrupt, state, api)
     actor = QueueActor(
         rx, interrupt, state, api, backlog or BacklogOpt(), logger, max_backoff

@@ -26,17 +26,20 @@ import ctypes
 import os
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from fishnet_tpu import telemetry as _telemetry
 from fishnet_tpu.chess.board import _VARIANT_CODES
 from fishnet_tpu.chess.core import NativeCoreError, load
 from fishnet_tpu.protocol.types import Variant
 from fishnet_tpu.nnue import spec
 from fishnet_tpu.nnue.weights import NnueWeights
+from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 
 
 @dataclass
@@ -186,6 +189,100 @@ def suggest_pipeline_depth(weights: "NnueWeights", size: int = 1024,
 
 def _round_up(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
+
+
+#: ``SearchService.counters()`` key -> (metric name, type, help). The
+#: exported names are part of the doc/observability.md contract; the
+#: native keys mirror cpp SearchCounters, the service keys the per-
+#: thread wire accounting.
+_COUNTER_METRICS = {
+    "steps": ("fishnet_pool_steps_total", "counter",
+              "Native pool step calls that advanced search fibers."),
+    "evals_shipped": ("fishnet_pool_evals_shipped_total", "counter",
+                      "Eval slots shipped to the device, cumulative."),
+    "suspensions": ("fishnet_pool_suspensions_total", "counter",
+                    "Fiber suspensions at leaf-eval blocks."),
+    "step_capacity": ("fishnet_pool_step_capacity_slots_total", "counter",
+                      "Configured batch capacity summed over steps."),
+    "demand_evals": ("fishnet_pool_demand_evals_total", "counter",
+                     "Demand (non-speculative) eval slots shipped."),
+    "prefetch_shipped": ("fishnet_pool_prefetch_shipped_total", "counter",
+                         "Speculative prefetch eval slots shipped."),
+    "prefetch_hits": ("fishnet_pool_prefetch_hits_total", "counter",
+                      "Speculative evals later consumed by a search."),
+    "tt_eval_hits": ("fishnet_pool_tt_eval_hits_total", "counter",
+                     "Leaf evals answered from the transposition table."),
+    "prefetch_budget": ("fishnet_pool_prefetch_budget", "gauge",
+                        "Current AIMD speculation budget (slots)."),
+    "delta_evals": ("fishnet_pool_delta_evals_total", "counter",
+                    "Eval slots shipped as incremental delta entries."),
+    "dedup_retired": ("fishnet_pool_dedup_retired_total", "counter",
+                      "Eval slots retired by in-batch deduplication."),
+    "nodes": ("fishnet_pool_nodes_total", "counter",
+              "Search nodes visited across all fibers."),
+    "anchor_deltas": ("fishnet_pool_anchor_deltas_total", "counter",
+                      "Delta evals resolved against device-resident "
+                      "anchors."),
+    "eval_steps": ("fishnet_service_eval_steps_total", "counter",
+                   "Device microbatches dispatched by the service."),
+    "bucket_slots": ("fishnet_service_bucket_slots_total", "counter",
+                     "Slots actually transferred (size-bucketed)."),
+    "wire_feature_bytes": ("fishnet_service_wire_feature_bytes_total",
+                           "counter",
+                           "Host->device feature payload bytes shipped."),
+    "wire_material_bytes": ("fishnet_service_wire_material_bytes_total",
+                            "counter",
+                            "Host->device material payload bytes shipped."),
+    "wire_bytes": ("fishnet_service_wire_bytes_total", "counter",
+                   "Total host->device payload bytes shipped."),
+}
+
+
+def _register_service_collector(svc: "SearchService") -> int:
+    """Adapt this service's counters as a pull collector. Holds only a
+    weakref: a service that is garbage collected (or closed, which
+    unregisters explicitly) stops being scraped."""
+    ref = weakref.ref(svc)
+
+    def collect():
+        service = ref()
+        if service is None or service._pool is None:
+            return None
+        fams = []
+        for key, value in service.counters().items():
+            spec_ = _COUNTER_METRICS.get(key)
+            if spec_ is None:
+                continue
+            name, kind, help_ = spec_
+            maker = (
+                _telemetry.gauge_family if kind == "gauge"
+                else _telemetry.counter_family
+            )
+            fams.append(maker(name, help_, value))
+        with service._lock:
+            pending = sum(len(p) for p in service._pending)
+            queued = sum(len(s) for s in service._submissions)
+        fams.append(_telemetry.gauge_family(
+            "fishnet_service_pending_searches",
+            "Searches currently occupying pool slots.", pending,
+        ))
+        fams.append(_telemetry.gauge_family(
+            "fishnet_service_queued_submissions",
+            "Searches queued but not yet in a slot.", queued,
+        ))
+        fams.append(_telemetry.gauge_family(
+            "fishnet_service_info",
+            "Static service configuration (value is always 1).", 1,
+            labels={
+                "backend": service.backend,
+                "psqt_path": getattr(service, "psqt_path", ""),
+                "driver_threads": str(service.driver_threads),
+                "pipeline_depth": str(service.pipeline_depth),
+            },
+        ))
+        return fams
+
+    return _telemetry.REGISTRY.register_collector(collect, name="search-service")
 
 
 #: Must cover the native core's largest single eval block
@@ -470,6 +567,13 @@ class SearchService:
             )
             for t in range(T)
         ]
+        # Telemetry: adapt the native + service counters as a pull-style
+        # collector (doc/observability.md). Registration is free until
+        # something actually scrapes /metrics; close() unregisters
+        # BEFORE freeing the pool — the registry's scrape lock
+        # guarantees no collector call is in flight once unregister
+        # returns, so a scrape can never read a freed pool.
+        self._collector_token = _register_service_collector(self)
         for th in self._threads:
             th.start()
 
@@ -699,6 +803,11 @@ class SearchService:
         self._wakes[pending.thread].set()
 
     def close(self) -> None:
+        # Blocks until no scrape is mid-collector: after this, nothing
+        # can call counters() against the pool freed below.
+        if self._collector_token is not None:
+            _telemetry.REGISTRY.unregister_collector(self._collector_token)
+            self._collector_token = None
         with self._lock:
             self._stopping = True
         # Unblock drivers stuck inside a long native step: every search
@@ -712,6 +821,9 @@ class SearchService:
         deadline = time.monotonic() + 60
         for th in self._threads:
             th.join(timeout=max(0.0, deadline - time.monotonic()))
+        if _telemetry.enabled():
+            # Clean-close flight-recorder dump (doc/observability.md).
+            _SPANS.dump(reason="close")
         if any(th.is_alive() for th in self._threads):
             # Driver stuck (e.g. inside a long XLA compile): leak the pool
             # rather than freeing memory a thread still dereferences.
@@ -1006,16 +1118,30 @@ class SearchService:
                 if self._stopping:
                     continue
 
+            # Flight-recorder gate, re-read per iteration: one module
+            # attribute read when telemetry is off — the disabled-by-
+            # default fast path keeping instrumentation off the device-
+            # dispatch critical path (doc/observability.md).
+            tel = _telemetry.enabled()
+
             stepped = 0
             for g in groups:
                 if g in inflight:
                     n_prev, arr = inflight.pop(g)
+                    t0 = time.monotonic() if tel else 0.0
                     values = self._resolve_eval(n_prev, arr)
+                    if tel:
+                        _SPANS.record("wire_decode", t0, group=g, n=n_prev)
+                        t0 = time.monotonic()
                     rc = lib.fc_pool_provide(
                         self._pool, g,
                         values.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                         n_prev,
                     )
+                    if tel:
+                        _SPANS.record(
+                            "postprocess", t0, group=g, n=n_prev, op="provide"
+                        )
                     if rc < 0:
                         # The pool refused a partial provide (anchors
                         # enabled): a service bug, not recoverable here —
@@ -1026,25 +1152,38 @@ class SearchService:
                         )
                 # Advance this group's fibers; fill its eval batch.
                 rows = ctypes.c_int32()
+                t0 = time.monotonic() if tel else 0.0
                 n = lib.fc_pool_step(
                     self._pool, g, packed_ptrs[g], offset_ptrs[g],
                     bucket_ptrs[g], slot_ptrs[g],
                     parent_ptrs[g], material_ptrs[g], self._group_capacity,
                     self._shard_align, ctypes.byref(rows),
                 )
+                if tel:
+                    _SPANS.record("pack", t0, group=g, n=n, rows=rows.value)
                 stepped += n
                 if n > 0:
                     if self._eval_fn is None:
                         raise NativeCoreError("no evaluator")  # pragma: no cover
+                    t0 = time.monotonic() if tel else 0.0
                     inflight[g] = (n, self._dispatch_eval(g, n, rows.value))
+                    if tel:
+                        _SPANS.record("device_step", t0, group=g, n=n)
 
             # Harvest this thread's finished searches.
             for g in groups:
+                t0 = time.monotonic() if tel else 0.0
+                harvested = 0
                 while True:
                     slot = lib.fc_pool_next_finished(self._pool, g)
                     if slot < 0:
                         break
                     self._finish_slot(t, slot)
+                    harvested += 1
+                if tel and harvested:
+                    _SPANS.record(
+                        "postprocess", t0, group=g, n=harvested, op="harvest"
+                    )
 
             if stepped == 0 and not inflight and all(
                 lib.fc_pool_active(self._pool, g) == 0 for g in groups
@@ -1123,6 +1262,11 @@ class SearchService:
             self._pending[t].clear()
             submissions = self._submissions[t]
             self._submissions[t] = []
+        if _telemetry.enabled() and (doomed or submissions):
+            # Crash forensics: a driver failing live searches dumps the
+            # flight recorder (the clean-drain call with nothing pending
+            # stays silent — close() makes the one clean-close dump).
+            _SPANS.dump(reason=f"fail_all:{err!r}"[:120])
         for pending in doomed:
             pending.loop.call_soon_threadsafe(_set_exc, pending.future, err)
         for item in submissions:

@@ -1,0 +1,72 @@
+"""Live telemetry: metrics registry, Prometheus exposition, and the
+span flight recorder.
+
+Three parts (see doc/observability.md for the exported-name contract):
+
+* :mod:`fishnet_tpu.telemetry.registry` — Counter/Gauge/Histogram with
+  per-thread cells aggregated at scrape time, plus pull-style collector
+  callbacks adapting the repo's existing counters;
+* :mod:`fishnet_tpu.telemetry.spans` — a fixed-size ring of
+  monotonic-clock spans around the pipeline stages, dumped as JSONL on
+  SIGUSR2, driver crash, and clean close;
+* :mod:`fishnet_tpu.telemetry.exporter` — ``/metrics`` (Prometheus
+  text) + ``/json`` on a stdlib ``http.server`` thread.
+
+Hot-path discipline: telemetry is **disabled by default**. Span
+instrumentation in the serving path is gated on :func:`enabled` (one
+module-attribute read when off); metric *collection* is pull-only, so a
+disabled or never-scraped process pays nothing at all. :func:`enable`
+is flipped once at startup by the ``--metrics-port`` wiring (or a test)
+before traffic starts — it is not a runtime toggle the hot paths must
+re-check consistency against.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fishnet_tpu.telemetry.registry import (  # noqa: F401 - public API
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricFamily,
+    MetricsRegistry,
+    Sample,
+    counter_family,
+    gauge_family,
+)
+from fishnet_tpu.telemetry.spans import (  # noqa: F401 - public API
+    RECORDER,
+    STAGES,
+    SpanRecorder,
+    install_signal_dump,
+)
+
+_enabled = False
+
+
+def enabled() -> bool:
+    """Whether hot-path span recording is on (off by default)."""
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def start_exporter(port: int, host: str = "127.0.0.1"):
+    """The one-call opt-in: enable span recording, arm the SIGUSR2 dump
+    (where the platform has it), and serve ``/metrics`` on ``port``
+    (0 = ephemeral). Returns the :class:`MetricsExporter`."""
+    from fishnet_tpu.telemetry.exporter import MetricsExporter
+
+    enable()
+    install_signal_dump()
+    return MetricsExporter(port=port, host=host)

@@ -1,0 +1,100 @@
+"""Exposition server: Prometheus text format plus a JSON snapshot on a
+stdlib ``http.server`` thread.
+
+Opt-in: nothing starts unless ``--metrics-port`` (or the ``MetricsPort``
+ini key) is set, or bench exports ``FISHNET_METRICS_PORT``. The server
+thread is independent of the asyncio event loop (R1: no blocking calls
+ride the loop) and mutates no state the serving path reads (R4: scrapes
+are read-only; the registry's scrape lock serializes them against
+collector unregistration).
+
+Endpoints:
+
+* ``GET /metrics`` — Prometheus text exposition (version 0.0.4)
+* ``GET /json``    — JSON snapshot of the same families
+* ``GET /spans``   — current flight-recorder contents as JSON
+* ``GET /healthz`` — liveness probe (``ok``)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from fishnet_tpu.telemetry.registry import REGISTRY, MetricsRegistry
+
+
+class MetricsExporter:
+    """Owns the HTTP server + its thread. ``port`` is the bound port
+    (useful with port 0 = ephemeral)."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry: Optional[MetricsRegistry] = None,
+    ) -> None:
+        registry = registry if registry is not None else REGISTRY
+        handler = _make_handler(registry)
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def _make_handler(registry: MetricsRegistry):
+    class _Handler(BaseHTTPRequestHandler):
+        # Scrapers poll; access-logging them to stderr is pure noise.
+        def log_message(self, fmt, *args):  # noqa: D401
+            pass
+
+        def _send(self, status: int, content_type: str, body: bytes) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+            path = self.path.split("?", 1)[0]
+            try:
+                if path == "/metrics":
+                    body = registry.render_prometheus().encode()
+                    self._send(
+                        200,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        body,
+                    )
+                elif path == "/json":
+                    body = json.dumps(registry.render_json()).encode()
+                    self._send(200, "application/json", body)
+                elif path == "/spans":
+                    from fishnet_tpu.telemetry.spans import RECORDER
+
+                    body = json.dumps({"spans": RECORDER.spans()}).encode()
+                    self._send(200, "application/json", body)
+                elif path == "/healthz":
+                    self._send(200, "text/plain", b"ok\n")
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+            except BrokenPipeError:
+                pass
+
+    return _Handler

@@ -1,0 +1,183 @@
+"""Span flight recorder: monotonic-clock spans around the pipeline
+stages, kept in fixed-size per-thread ring buffers, dumped as JSONL for
+crash forensics.
+
+The six stage names are a stable contract (doc/observability.md):
+
+* ``acquire``     — server round-trip acquiring work (net/api.py)
+* ``schedule``    — validate + expand an acquired batch (sched/queue.py)
+* ``pack``        — native fiber step + batch emission (fc_pool_step)
+* ``device_step`` — device dispatch of one eval microbatch
+* ``wire_decode`` — blocking on the dispatched array (wire + decode)
+* ``postprocess`` — provide values to fibers + harvest finished slots
+
+Recording is OFF by default: every instrumentation site is gated on
+``fishnet_tpu.telemetry.enabled()``, so with telemetry disabled the
+device-dispatch critical path pays one attribute read per step and the
+rings stay empty. When enabled, ``record()`` is one ``time.monotonic()``
+call plus a slot store into a preallocated per-thread ring — no lock,
+single writer per ring.
+
+Dumps append to ``FISHNET_SPANS_FILE`` (default
+``fishnet-spans-<pid>.jsonl`` in the working directory), one header
+object per dump then one object per span. They fire on SIGUSR2 (when
+installed via :func:`install_signal_dump`), on ``SearchService``
+driver-crash teardown (``_fail_all``), and on clean service close. Rings
+are not cleared by a dump, so successive dumps overlap — dedupe on the
+``seq`` field if that matters to a consumer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: The stage-name contract, in pipeline order.
+STAGES = (
+    "acquire", "schedule", "pack", "device_step", "wire_decode", "postprocess",
+)
+
+DEFAULT_CAPACITY = 4096  # spans kept per thread
+
+
+class _Ring:
+    """Single-writer fixed ring. The writer thread owns all mutation;
+    readers (dump) take a racy snapshot, which can at worst see one
+    half-updated slot — acceptable for forensics, free for the writer."""
+
+    __slots__ = ("items", "n", "thread")
+
+    def __init__(self, capacity: int, thread: str) -> None:
+        self.items: List[Optional[tuple]] = [None] * capacity
+        self.n = 0
+        self.thread = thread
+
+    def append(self, item: tuple) -> None:
+        self.items[self.n % len(self.items)] = item
+        self.n += 1
+
+    def snapshot(self) -> List[tuple]:
+        n = self.n
+        cap = len(self.items)
+        if n <= cap:
+            return [s for s in self.items[:n] if s is not None]
+        start = n % cap
+        return [
+            s for s in self.items[start:] + self.items[:start] if s is not None
+        ]
+
+
+class SpanRecorder:
+    """Per-thread span rings plus the JSONL dump machinery."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self._capacity = capacity
+        self._local = threading.local()
+        self._rings: List[_Ring] = []
+        self._lock = threading.Lock()  # ring creation + dump serialization
+        self._seq = 0
+        # Monotonic->epoch anchor so dump consumers can place spans on a
+        # wall clock.
+        self._epoch_offset = time.time() - time.monotonic()
+
+    # -- hot path ---------------------------------------------------------
+
+    def record(self, stage: str, started: float, **fields) -> None:
+        """Record a span that began at monotonic time ``started`` and
+        ends now. Call sites gate on ``telemetry.enabled()``."""
+        ring = getattr(self._local, "ring", None)
+        if ring is None:
+            ring = _Ring(self._capacity, threading.current_thread().name)
+            with self._lock:
+                self._rings.append(ring)
+            self._local.ring = ring
+        ring.append((stage, started, time.monotonic() - started, fields))
+
+    # -- dumping ----------------------------------------------------------
+
+    def spans(self) -> List[dict]:
+        """All recorded spans, oldest first, as dump-shaped dicts."""
+        with self._lock:
+            rings = list(self._rings)
+        out = []
+        for ring in rings:
+            for stage, started, dur, fields in ring.snapshot():
+                rec = {
+                    "stage": stage,
+                    "t": round(started, 6),
+                    "dur_ms": round(dur * 1e3, 3),
+                    "thread": ring.thread,
+                }
+                if fields:
+                    rec.update(fields)
+                out.append(rec)
+        out.sort(key=lambda r: r["t"])
+        return out
+
+    def stages_seen(self) -> set:
+        return {r["stage"] for r in self.spans()}
+
+    def default_path(self) -> str:
+        return os.environ.get(
+            "FISHNET_SPANS_FILE", f"fishnet-spans-{os.getpid()}.jsonl"
+        )
+
+    def dump(self, path: Optional[str] = None, reason: str = "manual") -> str:
+        """Append one header line + all spans (JSONL) to ``path``;
+        returns the path written. Never raises on I/O problems — the
+        dump is a forensic aid, not a liveness dependency."""
+        path = path or self.default_path()
+        spans = self.spans()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        header = {
+            "format": "fishnet-spans/1",
+            "seq": seq,
+            "reason": reason,
+            "pid": os.getpid(),
+            "dumped_at": time.time(),
+            "monotonic_to_epoch": round(self._epoch_offset, 6),
+            "spans": len(spans),
+        }
+        try:
+            with open(path, "a") as fp:
+                fp.write(json.dumps(header) + "\n")
+                for rec in spans:
+                    fp.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
+        return path
+
+
+#: Process-wide recorder (one flight recorder per process, like the
+#: registry: every subsystem's spans land in the same dump).
+RECORDER = SpanRecorder()
+
+_signal_installed = False
+
+
+def install_signal_dump(path: Optional[str] = None) -> bool:
+    """Install the SIGUSR2 -> dump handler (main thread only; no-op on
+    platforms without SIGUSR2, e.g. Windows). Returns True if armed."""
+    global _signal_installed
+    import signal
+
+    if not hasattr(signal, "SIGUSR2"):
+        return False
+    if _signal_installed:
+        return True
+
+    def _dump(signum, frame):  # pragma: no cover - exercised via os.kill
+        RECORDER.dump(path, reason="SIGUSR2")
+
+    try:
+        signal.signal(signal.SIGUSR2, _dump)
+    except (ValueError, OSError):
+        # Not the main thread, or the platform refused: stay unarmed.
+        return False
+    _signal_installed = True
+    return True

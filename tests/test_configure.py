@@ -164,6 +164,39 @@ def test_ini_invalid_engine(tmp_path):
         cfg.parse_and_configure(["run", "--conf", str(conf)], output=io.StringIO())
 
 
+def test_metrics_port(tmp_path, monkeypatch):
+    monkeypatch.setattr(cfg, "available_cores", lambda: 8)
+    conf = tmp_path / "fishnet.ini"
+    conf.write_text("[Fishnet]\nKey = k\nMetricsPort = 9187\n")
+    opt = cfg.parse_and_configure(
+        ["run", "--conf", str(conf)], output=io.StringIO()
+    )
+    assert opt.metrics_port == 9187
+    # CLI wins over ini; 0 (= ephemeral) must survive the merge.
+    opt = cfg.parse_and_configure(
+        ["run", "--conf", str(conf), "--metrics-port", "0"],
+        output=io.StringIO(),
+    )
+    assert opt.metrics_port == 0
+    # Default: telemetry off.
+    conf2 = tmp_path / "bare.ini"
+    conf2.write_text("[Fishnet]\nKey = k\n")
+    opt = cfg.parse_and_configure(
+        ["run", "--conf", str(conf2)], output=io.StringIO()
+    )
+    assert opt.metrics_port is None
+
+
+def test_metrics_port_invalid(tmp_path):
+    conf = tmp_path / "fishnet.ini"
+    conf.write_text("[Fishnet]\nKey = k\nMetricsPort = 70000\n")
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_and_configure(["run", "--conf", str(conf)], output=io.StringIO())
+    conf.write_text("[Fishnet]\nKey = k\nMetricsPort = web\n")
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_and_configure(["run", "--conf", str(conf)], output=io.StringIO())
+
+
 # -- dialog -----------------------------------------------------------------
 
 

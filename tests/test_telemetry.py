@@ -1,0 +1,571 @@
+"""Telemetry subsystem tests (doc/observability.md).
+
+Covers the registry primitives (per-thread cells, collector lifecycle),
+Prometheus text-format rendering, the exposition server (the tier-1
+`make metrics-smoke` contract scrape), the span flight recorder +
+SIGUSR2 dump, the net/api outcome counters against the fake server, the
+debounced stats file, and agreement between `/metrics` and
+`SearchService.counters()` / `StatsRecorder` totals under real load.
+"""
+
+import asyncio
+import json
+import os
+import re
+import signal
+import threading
+import urllib.request
+
+import pytest
+
+from fishnet_tpu import telemetry
+from fishnet_tpu.net import api as api_mod
+from fishnet_tpu.telemetry.exporter import MetricsExporter
+from fishnet_tpu.telemetry.registry import MetricsRegistry
+from fishnet_tpu.telemetry.spans import (
+    RECORDER,
+    STAGES,
+    SpanRecorder,
+    install_signal_dump,
+)
+from fishnet_tpu.utils.logger import Logger
+from fishnet_tpu.utils.stats import StatsRecorder, register_stats_collector
+from tests.fake_server import VALID_KEY, FakeServer
+
+
+@pytest.fixture
+def tel_enabled():
+    telemetry.enable()
+    try:
+        yield
+    finally:
+        telemetry.disable()
+
+
+# -- Prometheus text-format validation --------------------------------------
+
+_HELP_RE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*$")
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram|summary|untyped)$"
+)
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(?:\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*")*\})?'
+    r" -?[0-9]+(?:\.[0-9]+)?(?:[eE][-+]?[0-9]+)?$"
+)
+
+
+def assert_prometheus_format(text: str) -> dict:
+    """Validate exposition-format 0.0.4 syntax; return {family: type}."""
+    types = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            m = _TYPE_RE.match(line)
+            assert m, f"bad TYPE line: {line!r}"
+            types[m.group(1)] = m.group(2)
+        elif line.startswith("#"):
+            assert _HELP_RE.match(line), f"bad comment line: {line!r}"
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"bad sample line: {line!r}"
+            name = m.group(1)
+            family = re.sub(r"_(bucket|sum|count)$", "", name)
+            assert name in types or family in types, f"untyped sample: {name}"
+    return types
+
+
+def _sample_value(text: str, name: str, **labels) -> float:
+    """Parse one sample's value out of exposition text."""
+    for line in text.splitlines():
+        if not line.startswith(name + "{") and not line.startswith(name + " "):
+            continue
+        if all(f'{k}="{v}"' in line for k, v in labels.items()):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"sample {name}{labels} not found")
+
+
+# -- registry primitives ----------------------------------------------------
+
+
+def test_counter_aggregates_across_threads():
+    reg = MetricsRegistry()
+    c = reg.counter("t_counter_total", "test")
+    threads = [
+        threading.Thread(target=lambda: [c.inc() for _ in range(1000)])
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8000
+
+
+def test_counter_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("t_labeled_total", "test", labelnames=("kind",))
+    c.inc(kind="a")
+    c.inc(2, kind="b")
+    assert c.value(kind="a") == 1
+    assert c.value(kind="b") == 2
+    with pytest.raises(ValueError):
+        c.inc(wrong="x")
+
+
+def test_instrument_type_conflict_and_reuse():
+    reg = MetricsRegistry()
+    c = reg.counter("t_dup", "test")
+    assert reg.counter("t_dup", "test") is c  # idempotent re-registration
+    with pytest.raises(ValueError):
+        reg.gauge("t_dup", "test")
+
+
+def test_gauge_set_and_function():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_gauge", "test")
+    g.set(41.0)
+    assert g.collect().samples[0].value == 41.0
+    g.set_function(lambda: 7.0)
+    assert g.collect().samples[0].value == 7.0
+
+
+def test_histogram_buckets_cumulative():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_hist", "test", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    fam = h.collect()
+    by_le = {
+        s.labels["le"]: s.value for s in fam.samples if s.name == "t_hist_bucket"
+    }
+    assert by_le == {"0.1": 1, "1": 3, "10": 4, "+Inf": 5}
+    count = next(s for s in fam.samples if s.name == "t_hist_count")
+    total = next(s for s in fam.samples if s.name == "t_hist_sum")
+    assert count.value == 5
+    assert total.value == pytest.approx(56.05)
+
+
+def test_collector_lifecycle():
+    reg = MetricsRegistry()
+    calls = []
+
+    def good():
+        calls.append("good")
+        return [telemetry.counter_family("t_coll_total", "test", 3)]
+
+    state = {"alive": True}
+
+    def dying():
+        # Weakref-to-owner idiom: None once the owner is gone.
+        return [] if state["alive"] else None
+
+    def bad():
+        raise RuntimeError("boom")
+
+    reg.register_collector(good, name="good")
+    reg.register_collector(dying, name="dying")
+    reg.register_collector(bad, name="bad")
+
+    fams = {f.name: f for f in reg.collect()}
+    assert fams["t_coll_total"].samples[0].value == 3
+    # The raising collector is counted, and the scrape survives it.
+    errs = fams["fishnet_telemetry_collector_errors_total"]
+    assert any(
+        s.labels.get("collector") == "bad" and s.value == 1 for s in errs.samples
+    )
+
+    state["alive"] = False
+    reg.collect()  # dying returns None -> self-unregisters
+    with reg._lock:
+        names = [name for name, _ in reg._collectors.values()]
+    assert "dying" not in names and "good" in names
+
+
+def test_unregister_collector_prevents_further_calls():
+    reg = MetricsRegistry()
+    calls = []
+    token = reg.register_collector(lambda: calls.append(1) or [], name="x")
+    reg.collect()
+    reg.unregister_collector(token)
+    reg.collect()
+    assert calls == [1]
+
+
+def test_render_prometheus_format():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "counter with\nnewline help", labelnames=("q",))
+    c.inc(q='va"l\\ue')  # label escaping
+    reg.gauge("t_g", "gauge").set(1.5)
+    reg.histogram("t_h", "hist", buckets=(0.5,)).observe(0.1)
+    types = assert_prometheus_format(reg.render_prometheus())
+    assert types == {
+        "fishnet_telemetry_collector_errors_total": "counter",
+        "t_total": "counter",
+        "t_g": "gauge",
+        "t_h": "histogram",
+    }
+
+
+def test_render_json_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("t_total", "test").inc(2)
+    snap = reg.render_json()
+    assert snap["metrics"]["t_total"]["type"] == "counter"
+    assert snap["metrics"]["t_total"]["samples"][0]["value"] == 2
+
+
+# -- exposition server: the tier-1 metrics-smoke contract scrape ------------
+
+#: Families every process exports unconditionally (module-level
+#: instruments in net/api.py + the registry's own error counter). The
+#: names are the doc/observability.md contract.
+CONTRACT_FAMILIES = (
+    "fishnet_api_request_seconds",
+    "fishnet_api_requests_total",
+    "fishnet_api_rejected_total",
+    "fishnet_api_suspensions_total",
+    "fishnet_api_suspended_seconds_total",
+    "fishnet_telemetry_collector_errors_total",
+)
+
+
+def _scrape(exporter: MetricsExporter, path: str) -> bytes:
+    with urllib.request.urlopen(f"{exporter.url}{path}", timeout=10) as res:
+        return res.read()
+
+
+def test_metrics_smoke():
+    """Start the exporter on an ephemeral port, scrape /metrics, and
+    validate Prometheus syntax + presence of the contract metrics."""
+    exporter = MetricsExporter(port=0)
+    try:
+        text = _scrape(exporter, "/metrics").decode()
+        types = assert_prometheus_format(text)
+        for family in CONTRACT_FAMILIES:
+            assert family in types, f"contract family missing: {family}"
+        assert types["fishnet_api_request_seconds"] == "histogram"
+        assert types["fishnet_api_requests_total"] == "counter"
+
+        snap = json.loads(_scrape(exporter, "/json"))
+        for family in CONTRACT_FAMILIES:
+            assert family in snap["metrics"]
+        assert _scrape(exporter, "/healthz") == b"ok\n"
+        assert "spans" in json.loads(_scrape(exporter, "/spans"))
+        with pytest.raises(urllib.request.HTTPError):
+            _scrape(exporter, "/nope")
+    finally:
+        exporter.close()
+
+
+def test_start_exporter_enables_telemetry(tmp_path, monkeypatch):
+    monkeypatch.setenv("FISHNET_SPANS_FILE", str(tmp_path / "s.jsonl"))
+    exporter = telemetry.start_exporter(0)
+    try:
+        assert telemetry.enabled()
+        assert_prometheus_format(_scrape(exporter, "/metrics").decode())
+    finally:
+        exporter.close()
+        telemetry.disable()
+
+
+# -- span flight recorder ---------------------------------------------------
+
+
+def test_ring_wraps_keeps_latest():
+    rec = SpanRecorder(capacity=4)
+    import time as _time
+
+    for i in range(10):
+        rec.record("pack", _time.monotonic(), i=i)
+    got = [s["i"] for s in rec.spans()]
+    assert got == [6, 7, 8, 9]
+
+
+def test_dump_jsonl_format(tmp_path):
+    rec = SpanRecorder()
+    import time as _time
+
+    for stage in STAGES:
+        rec.record(stage, _time.monotonic(), n=1)
+    path = tmp_path / "spans.jsonl"
+    rec.dump(str(path), reason="test")
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    header, spans = lines[0], lines[1:]
+    assert header["format"] == "fishnet-spans/1"
+    assert header["reason"] == "test"
+    assert header["spans"] == len(spans) == len(STAGES)
+    assert {s["stage"] for s in spans} == set(STAGES)
+    for s in spans:
+        assert s["dur_ms"] >= 0 and "thread" in s
+
+
+def test_sigusr2_dumps_flight_recorder(tmp_path, monkeypatch):
+    if not hasattr(signal, "SIGUSR2"):
+        pytest.skip("no SIGUSR2 on this platform")
+    path = tmp_path / "sig-spans.jsonl"
+    monkeypatch.setenv("FISHNET_SPANS_FILE", str(path))
+    import time as _time
+
+    for stage in STAGES:
+        RECORDER.record(stage, _time.monotonic())
+    assert install_signal_dump()
+    os.kill(os.getpid(), signal.SIGUSR2)
+    deadline = _time.monotonic() + 5
+    while not path.exists() and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert lines[0]["reason"] == "SIGUSR2"
+    assert {s["stage"] for s in lines[1:]} >= set(STAGES)
+
+
+# -- net/api outcome counters (429 suspension + reject paths) ---------------
+
+pytestmark = pytest.mark.anyio
+
+
+def _acquire_hist():
+    return api_mod._REQUEST_SECONDS.labels(endpoint="acquire").snapshot()
+
+
+async def test_api_reject_counters():
+    """400/401/403/406 on acquire: rejected counter + ok outcome +
+    a latency observation land in the instruments."""
+    async with FakeServer() as server:
+        server.lichess.reject_with = 406
+        stub, actor = api_mod.channel(
+            server.endpoint, VALID_KEY, Logger(verbose=0)
+        )
+        task = asyncio.create_task(actor.run())
+        rej0 = api_mod._REJECTS.value(endpoint="acquire", status="406")
+        ok0 = api_mod._REQUESTS.value(endpoint="acquire", outcome="ok")
+        counts0, sum0, n0 = _acquire_hist()
+        try:
+            acquired = await stub.acquire(slow=False)
+        finally:
+            actor.stop()
+            await asyncio.wait_for(task, timeout=10)
+        assert acquired is not None and acquired.kind.value == "rejected"
+        assert api_mod._REJECTS.value(endpoint="acquire", status="406") == rej0 + 1
+        # A reject is a *successful* round trip (outcome=ok): the server
+        # answered; it is the answer that stops the queue.
+        assert api_mod._REQUESTS.value(endpoint="acquire", outcome="ok") == ok0 + 1
+        counts1, sum1, n1 = _acquire_hist()
+        assert n1 == n0 + 1 and sum1 >= sum0
+        # Cumulative-bucket sanity: every bucket is monotone in time and
+        # the overflow (+Inf) count equals the total observation count.
+        assert all(c1 >= c0 for c0, c1 in zip(counts0, counts1))
+        assert sum(counts1) <= n1
+
+
+async def test_api_rate_limited_counters():
+    """429 on acquire: rate_limited outcome + suspension counters, and
+    the suspension-seconds counter accrues the >= 60 s backoff."""
+    async with FakeServer() as server:
+        server.lichess.reject_with = 429
+        stub, actor = api_mod.channel(
+            server.endpoint, VALID_KEY, Logger(verbose=0)
+        )
+        task = asyncio.create_task(actor.run())
+        rl0 = api_mod._REQUESTS.value(endpoint="acquire", outcome="rate_limited")
+        susp0 = api_mod._SUSPENSIONS.value()
+        sec0 = api_mod._SUSPENDED_SECONDS.value()
+        _, _, n0 = _acquire_hist()
+        try:
+            # The future is failed before the actor parks in its 60 s
+            # suspension sleep, so this returns promptly (None).
+            acquired = await asyncio.wait_for(stub.acquire(slow=False), timeout=10)
+        finally:
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+        assert acquired is None
+        assert (
+            api_mod._REQUESTS.value(endpoint="acquire", outcome="rate_limited")
+            == rl0 + 1
+        )
+        assert api_mod._SUSPENSIONS.value() == susp0 + 1
+        assert api_mod._SUSPENDED_SECONDS.value() >= sec0 + 60.0
+        _, _, n1 = _acquire_hist()
+        assert n1 == n0 + 1
+
+
+# -- stats recorder: debounce + collector -----------------------------------
+
+
+def test_default_stats_file_no_home(monkeypatch):
+    from pathlib import Path
+
+    from fishnet_tpu.utils import stats as stats_mod
+
+    def no_home():
+        raise RuntimeError("no home directory")
+
+    monkeypatch.setattr(Path, "home", no_home)
+    assert stats_mod.default_stats_file() is None
+
+
+def test_stats_flush_debounced(tmp_path):
+    path = tmp_path / "stats.json"
+    rec = StatsRecorder(cores=2, stats_file=path, flush_interval=3600.0)
+    rec.record_batch(positions=10, nodes=1000, nnue_nps=5000)
+    # First batch flushes immediately so short runs persist.
+    assert json.loads(path.read_text())["total_batches"] == 1
+    rec.record_batch(positions=10, nodes=1000)
+    rec.record_batch(positions=10, nodes=1000)
+    # Within the interval: on-disk copy is stale by design.
+    assert json.loads(path.read_text())["total_batches"] == 1
+    rec.flush()
+    assert json.loads(path.read_text())["total_batches"] == 3
+    mtime = path.stat().st_mtime_ns
+    rec.flush()  # not dirty -> no rewrite
+    assert path.stat().st_mtime_ns == mtime
+
+
+def test_stats_collector_exposes_totals():
+    rec = StatsRecorder(cores=4, no_stats_file=True)
+    rec.record_batch(positions=7, nodes=420, nnue_nps=1000)
+    token = register_stats_collector(rec)
+    try:
+        text = telemetry.REGISTRY.render_prometheus()
+        assert _sample_value(text, "fishnet_stats_batches_total") == 1
+        assert _sample_value(text, "fishnet_stats_positions_total") == 7
+        assert _sample_value(text, "fishnet_stats_nodes_total") == 420
+        assert _sample_value(text, "fishnet_nnue_nps") > 0
+    finally:
+        telemetry.REGISTRY.unregister_collector(token)
+
+
+# -- SearchService under load: /metrics agrees with counters() --------------
+
+
+async def test_service_metrics_agree_with_counters(tmp_path, monkeypatch, tel_enabled):
+    """Acceptance: scrape a live service and require exact agreement
+    with counters(), plus pipeline-stage spans from the driver."""
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.search.service import SearchService
+
+    monkeypatch.setenv("FISHNET_SPANS_FILE", str(tmp_path / "svc.jsonl"))
+    svc = SearchService(
+        weights=NnueWeights.random(seed=5),
+        pool_slots=32,
+        batch_capacity=32,
+        tt_bytes=1 << 20,
+        backend="scalar",
+    )
+    exporter = MetricsExporter(port=0)
+    try:
+        await asyncio.gather(*(
+            svc.search(
+                "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+                [],
+                depth=3,
+            )
+            for _ in range(4)
+        ))
+        # Quiesced drivers: two successive counter reads must agree, and
+        # the scrape between them must match exactly.
+        for _ in range(50):
+            before = svc.counters()
+            text = _scrape(exporter, "/metrics").decode()
+            if svc.counters() == before:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            pytest.fail("service never quiesced")
+        assert_prometheus_format(text)
+        assert _sample_value(text, "fishnet_pool_nodes_total") == before["nodes"]
+        assert _sample_value(text, "fishnet_pool_steps_total") == before["steps"]
+        assert (
+            _sample_value(text, "fishnet_pool_evals_shipped_total")
+            == before["evals_shipped"]
+        )
+        assert (
+            _sample_value(text, "fishnet_service_eval_steps_total")
+            == before["eval_steps"]
+        )
+        assert (
+            _sample_value(text, "fishnet_service_wire_bytes_total")
+            == before["wire_bytes"]
+        )
+        assert _sample_value(text, "fishnet_service_info", backend="scalar") == 1
+        # The driver recorded spans for the service-side pipeline stages.
+        assert RECORDER.stages_seen() >= {
+            "pack", "device_step", "wire_decode", "postprocess",
+        }
+    finally:
+        exporter.close()
+        svc.close()
+    # close() unregisters the collector: the next scrape must not see
+    # the service families (the freed-pool guard).
+    text = telemetry.REGISTRY.render_prometheus()
+    assert "fishnet_pool_nodes_total" not in text
+
+
+# -- full pipeline: all six stages in one SIGUSR2 dump ----------------------
+
+
+async def test_pipeline_spans_cover_all_stages(tmp_path, monkeypatch, tel_enabled):
+    """Fake server -> client -> queue -> TPU engine -> service, with
+    telemetry on: the SIGUSR2 dump covers all six pipeline stages."""
+    if not hasattr(signal, "SIGUSR2"):
+        pytest.skip("no SIGUSR2 on this platform")
+    from fishnet_tpu.client import Client
+    from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.search.service import SearchService
+
+    path = tmp_path / "pipeline.jsonl"
+    monkeypatch.setenv("FISHNET_SPANS_FILE", str(path))
+    svc = SearchService(
+        weights=NnueWeights.random(seed=11),
+        pool_slots=32,
+        batch_capacity=32,
+        tt_bytes=1 << 20,
+        backend="scalar",
+    )
+    try:
+        async with FakeServer() as server:
+            work_id = server.lichess.add_analysis_job(
+                moves="e2e4 c7c5", nodes=200
+            )
+            client = Client(
+                endpoint=server.endpoint,
+                key=VALID_KEY,
+                cores=2,
+                engine_factory=TpuNnueEngineFactory(svc),
+                logger=Logger(verbose=0),
+                max_backoff=0.2,
+            )
+            await client.start()
+            deadline = asyncio.get_running_loop().time() + 60
+            while (
+                work_id not in server.lichess.analyses
+                and asyncio.get_running_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            await client.stop()
+            assert work_id in server.lichess.analyses
+    finally:
+        svc.close()
+    assert install_signal_dump()
+    os.kill(os.getpid(), signal.SIGUSR2)
+    import time as _time
+
+    deadline = _time.monotonic() + 5
+    while _time.monotonic() < deadline:
+        if path.exists() and any(
+            json.loads(l).get("reason") == "SIGUSR2"
+            for l in path.read_text().splitlines()
+            if '"format"' in l
+        ):
+            break
+        _time.sleep(0.01)
+    stages = {
+        json.loads(l)["stage"]
+        for l in path.read_text().splitlines()
+        if '"stage"' in l
+    }
+    assert stages >= set(STAGES), f"missing stages: {set(STAGES) - stages}"
