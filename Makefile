@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
@@ -15,6 +15,15 @@ analysis:
 # contract families, span dumps, net/api outcome counters.
 metrics-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_telemetry.py -q
+
+# Resilience contract (doc/resilience.md): a <=60 s soak under the
+# canned fault plan (acquire flaps + submit failures + one engine
+# crash + one device_step crash) asserting ledger-clean exit (every
+# acquired batch submitted exactly once), at least one fused->xla
+# degradation + pool respawn, and the four resilience metric families
+# on /metrics.
+soak-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_soak.py -q
 
 # ASan+UBSan pool stress incl. the anchor full-provide guard case —
 # the non-tier-1 `slow` job.

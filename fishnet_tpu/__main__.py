@@ -122,14 +122,16 @@ def build_sharded_evaluator(opt: Opt, weights, logger: Logger):
     )
 
 
-def build_search_service(opt: Opt, logger: Logger):
+def build_search_service(opt: Opt, logger: Logger, psqt_path=None):
     """The shared batched-search backend, from CLI options (dev-mode
     random weights when no --nnue-file is given). Without --pipeline the
     depth is probed for DEVICE dispatch overlap and floored at 2: even
     on fully serialized tunnels the host phase (fiber stepping, feature
     extraction) overlaps the other group's wire wait. With >1 visible
     device (or an explicit --mesh) eval batches are sharded over a
-    device mesh instead of riding one chip."""
+    device mesh instead of riding one chip. ``psqt_path`` requests a
+    rung of the eval-path lattice (the degradation ladder's seam,
+    resilience/supervisor.py); None = auto-select."""
     from fishnet_tpu.nnue.weights import NnueWeights
     from fishnet_tpu.search.service import SearchService, suggest_pipeline_depth
 
@@ -172,6 +174,7 @@ def build_search_service(opt: Opt, logger: Logger):
         pipeline_depth=depth,
         evaluator=evaluator,
         driver_threads=opt.resolved_search_threads(),
+        psqt_path=psqt_path,
     )
 
 
@@ -181,11 +184,18 @@ def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
     engine = opt.resolved_engine()
     if engine == "tpu-nnue":
         from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
+        from fishnet_tpu.resilience.supervisor import ServiceSupervisor
 
         validate_mesh(opt)  # fail fast; the service builds lazily
-        return TpuNnueEngineFactory(
-            service_builder=lambda: build_search_service(opt, logger)
+        # The supervisor owns respawns: every rebuild of a dead service
+        # goes through its bounded respawn budget and — after repeated
+        # rapid deaths — steps the eval path down the degradation
+        # ladder (fused -> xla -> host-material, doc/resilience.md).
+        supervisor = ServiceSupervisor(
+            lambda rung: build_search_service(opt, logger, psqt_path=rung),
+            logger=logger,
         )
+        return TpuNnueEngineFactory(service_builder=supervisor.build)
     if engine == "az-mcts":
         import jax
 
@@ -268,6 +278,18 @@ async def run_client(opt: Opt, logger: Logger) -> None:
             "(SIGUSR2 dumps the span flight recorder)."
         )
 
+    # Deterministic fault injection (--fault-plan / FISHNET_FAULT_PLAN):
+    # a testing/soak aid — loudly flagged, never silently active.
+    plan_spec = opt.resolved_fault_plan()
+    if plan_spec:
+        from fishnet_tpu.resilience import faults
+
+        faults.install(plan_spec)
+        logger.error(
+            f"FAULT INJECTION ACTIVE ({plan_spec!r}). "
+            "Never run this against production traffic."
+        )
+
     engine_factory = build_engine_factory(opt, logger)
     client = Client(
         endpoint=opt.resolved_endpoint(),
@@ -279,6 +301,7 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         backlog=BacklogOpt(user=opt.user_backlog, system=opt.system_backlog),
         max_backoff=opt.resolved_max_backoff(),
         workers=opt.resolved_workers(),
+        batch_deadline=opt.batch_deadline,
     )
     if opt.resolved_workers() != opt.resolved_cores():
         shared = opt.resolved_engine() in ("tpu-nnue", "az-mcts")

@@ -862,9 +862,128 @@ def _self_attr_of_target(node: ast.AST) -> Optional[str]:
     return None
 
 
+# ---------------------------------------------------------------------------
+# R5: swallowed exceptions in the serving layers
+# ---------------------------------------------------------------------------
+
+#: Resolved exception names that are "broad": catching one of these (or
+#: a bare ``except:``) without making the failure observable hides real
+#: outages from the recovery machinery and the telemetry plane.
+_BROAD_EXCEPTIONS = {
+    "Exception",
+    "BaseException",
+    "builtins.Exception",
+    "builtins.BaseException",
+}
+
+
+class SwallowedExceptionRule:
+    """Broad ``except`` handlers in the serving layers (``net/``,
+    ``sched/``, ``search/``) must make the failure observable: either
+    re-raise, increment a telemetry counter (``.inc(...)``), or
+    propagate the exception as a value (``return err`` /
+    ``set_exception(err)``). Logging alone is NOT enough — log lines
+    are invisible to the metrics plane the resilience subsystem (and
+    any alerting built on it) watches. Narrow handlers (specific
+    exception types) are exempt: catching what you expect is handling,
+    not swallowing."""
+
+    id = "R5"
+    name = "swallowed-exception"
+
+    #: Serving-layer module prefixes this rule polices. Stand-alone
+    #: files (no package anchor — the test fixtures) are always in
+    #: scope so the rule itself stays testable.
+    _SCOPES = ("fishnet_tpu.net", "fishnet_tpu.sched", "fishnet_tpu.search")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules.values():
+            if "." in mod.name and not (
+                mod.name in self._SCOPES
+                or mod.name.startswith(tuple(s + "." for s in self._SCOPES))
+            ):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not self._is_broad(project, mod, node):
+                    continue
+                if self._is_observable(node):
+                    continue
+                caught = (
+                    "bare except" if node.type is None
+                    else f"except {ast.unparse(node.type)}"
+                )
+                yield Finding(
+                    rule=self.id,
+                    path=str(mod.path),
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"`{caught}` swallows the failure: the handler "
+                        "neither re-raises, increments a telemetry "
+                        "counter, nor propagates the exception as a value"
+                    ),
+                    suggestion=(
+                        "narrow the exception type, `raise`, count it "
+                        "(`<counter>.inc(...)`), or hand it on (`return "
+                        "err` / `future.set_exception(err)`); justified "
+                        "suppressions: `# fishnet: ignore[R5] -- why`"
+                    ),
+                )
+
+    def _is_broad(self, project: Project, mod: Module, node) -> bool:
+        if node.type is None:
+            return True
+        types = (
+            node.type.elts if isinstance(node.type, ast.Tuple) else [node.type]
+        )
+        for t in types:
+            dotted = project.resolve_dotted(t, mod.imports)
+            if dotted in _BROAD_EXCEPTIONS:
+                return True
+        return False
+
+    def _is_observable(self, handler) -> bool:
+        """The handler body (nested defs excluded — they don't run here)
+        makes the failure observable."""
+        name = handler.name
+        for node in _walk_own_stmts(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                # A telemetry counter increment, or propagation into a
+                # future the caller is awaiting.
+                if node.func.attr in ("inc", "set_exception"):
+                    return True
+            if (
+                name is not None
+                and isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == name
+            ):
+                return True  # `return err`: propagation by value
+        return False
+
+
+def _walk_own_stmts(handler) -> Iterator[ast.AST]:
+    """Walk an except handler's body without descending into nested
+    function definitions or lambdas."""
+    stack: List[ast.AST] = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
 ALL_RULES = [
     AsyncBlockingRule(),
     JitHostSyncRule(),
     DeprecatedJaxRule(),
     CrossThreadStateRule(),
+    SwallowedExceptionRule(),
 ]

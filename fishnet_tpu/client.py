@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 
 from fishnet_tpu import telemetry as _telemetry
 from fishnet_tpu.engine.base import Engine, EngineError, EngineFactory
+from fishnet_tpu.resilience import faults as _faults
 from fishnet_tpu.ipc import Position, PositionFailed
 from fishnet_tpu.net import api as api_mod
 from fishnet_tpu.sched import queue as queue_mod
@@ -73,10 +74,18 @@ async def worker(
                     budget = DEFAULT_BUDGET_SECONDS
                     note("starting_engine")
                     try:
+                        # "engine.spawn" fault site: models a failed
+                        # engine start (binary gone, service rebuild
+                        # failure) at the one chokepoint every engine
+                        # backend passes through.
+                        if _faults.enabled():
+                            await _faults.fire_async("engine.spawn")
                         engine = await factory.create(flavor)
-                    except EngineError as err:
+                    except (EngineError, _faults.FaultInjected) as err:
                         logger.error(f"Worker {i} failed to start engine: {err}")
-                        response = PositionFailed(batch_id=job.work.id)
+                        response = PositionFailed(
+                            batch_id=job.work.id, position_id=job.position_id
+                        )
                         job = None
 
                 if engine is not None:
@@ -94,7 +103,9 @@ async def worker(
                             f"faster clients. Context: {job.url or job.work.id}"
                         )
                         await engine.close()
-                        response = PositionFailed(batch_id=job.work.id)
+                        response = PositionFailed(
+                            batch_id=job.work.id, position_id=job.position_id
+                        )
                     except asyncio.CancelledError:
                         await engine.close()
                         raise
@@ -104,7 +115,9 @@ async def worker(
                             f"Context: {job.url or job.work.id}"
                         )
                         await engine.close()
-                        response = PositionFailed(batch_id=job.work.id)
+                        response = PositionFailed(
+                            batch_id=job.work.id, position_id=job.position_id
+                        )
                     budget = max(0.0, budget - (time.monotonic() - started))
                     if budget < DEFAULT_BUDGET_SECONDS:
                         logger.debug(f"Low engine timeout budget: {budget:.1f}s")
@@ -145,6 +158,12 @@ class Client:
     # positions CONCURRENTLY instead of one per device round-trip
     # (__main__ sets this from --search-concurrency / an auto default).
     workers: Optional[int] = None
+    # Per-batch deadline budget (seconds): a pending batch older than
+    # this is FLUSHED — its completed plies submitted, the rest marked
+    # skipped — instead of wedging the queue behind a hung engine
+    # (doc/resilience.md). None = no deadline (the reference model:
+    # the server's own timeout reassigns).
+    batch_deadline: Optional[float] = None
 
     _tasks: List[asyncio.Task] = field(default_factory=list)
     _queue_stub: Optional[queue_mod.QueueStub] = None
@@ -196,6 +215,7 @@ class Client:
             stats=self.stats,
             backlog=self.backlog,
             max_backoff=self.max_backoff,
+            batch_deadline=self.batch_deadline,
         )
         self._queue_stub = queue_stub
         self._tasks.append(asyncio.create_task(queue_actor.run(), name="queue"))

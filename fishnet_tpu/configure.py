@@ -207,6 +207,16 @@ class Opt:
     #: off (the default; hot paths pay one flag check); 0 = an ephemeral
     #: port (logged at startup); otherwise the port /metrics binds on.
     metrics_port: Optional[int] = None
+    #: Deterministic fault plan (doc/resilience.md grammar). None =
+    #: fault injection off (the default; sites pay one flag check).
+    #: ``FISHNET_FAULT_PLAN`` in the environment is the fallback for
+    #: processes not started via this CLI.
+    fault_plan: Optional[str] = None
+    #: Per-batch deadline budget in seconds: a pending batch older than
+    #: this is flushed as a partial analysis instead of wedging the
+    #: queue (doc/resilience.md). None = no deadline (the reference
+    #: model: the server's own timeout reassigns).
+    batch_deadline: Optional[float] = None
 
     def conf_path(self) -> Path:
         return Path(self.conf) if self.conf else Path("fishnet.ini")
@@ -240,6 +250,9 @@ class Opt:
 
     def resolved_mesh(self) -> str:
         return self.mesh or "auto"
+
+    def resolved_fault_plan(self) -> Optional[str]:
+        return self.fault_plan or os.environ.get("FISHNET_FAULT_PLAN") or None
 
     def resolved_command(self) -> str:
         return self.command or "run"
@@ -302,6 +315,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "/json snapshot) on this port and arm the SIGUSR2 "
                         "span-dump. 0 picks an ephemeral port. Default: "
                         "telemetry off.")
+    p.add_argument("--fault-plan", default=None,
+                   help="Deterministic fault plan (doc/resilience.md "
+                        "grammar), e.g. 'seed=7;net.acquire:nth=2:error'. "
+                        "Testing/soak aid — never set in production. "
+                        "Default: fault injection off "
+                        "(FISHNET_FAULT_PLAN is the env fallback).")
+    p.add_argument("--batch-deadline", default=None,
+                   help="Per-batch deadline budget (duration, e.g. 120s): "
+                        "batches older than this are flushed as partial "
+                        "analyses instead of wedging the queue. Default: "
+                        "no deadline.")
     return p
 
 
@@ -351,7 +375,26 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
         opt.mesh = parse_mesh(ns.mesh)
     if ns.metrics_port is not None:
         opt.metrics_port = _parse_port(str(ns.metrics_port))
+    if ns.fault_plan is not None:
+        opt.fault_plan = _parse_fault_plan(ns.fault_plan)
+    if ns.batch_deadline is not None:
+        opt.batch_deadline = parse_duration(ns.batch_deadline)
+        if opt.batch_deadline <= 0:
+            raise ConfigError("--batch-deadline must be positive")
     return opt
+
+
+def _parse_fault_plan(value: str) -> str:
+    """Validate a fault-plan spec at config time (the plan grammar lives
+    in resilience/faults.py) so a typo fails with a ConfigError instead
+    of a traceback at first injection."""
+    from fishnet_tpu.resilience.faults import FaultPlan, FaultPlanError
+
+    try:
+        FaultPlan.parse(value)
+    except FaultPlanError as err:
+        raise ConfigError(f"invalid --fault-plan: {err}") from err
+    return value
 
 
 def _parse_port(value: str) -> int:
@@ -385,6 +428,8 @@ _INI_FIELDS = (
     ("SearchConcurrency", "search_concurrency",
      lambda v: _positive_int(v, "SearchConcurrency")),
     ("MetricsPort", "metrics_port", lambda v: _parse_port(v)),
+    ("FaultPlan", "fault_plan", lambda v: _parse_fault_plan(v)),
+    ("BatchDeadline", "batch_deadline", parse_duration),
 )
 
 

@@ -83,7 +83,13 @@ class PositionResponse:
 
 @dataclass(frozen=True)
 class PositionFailed:
+    """A position the engine tier could not analyse. With
+    ``position_id`` the scheduler requeues just that position (bounded
+    generations, sched/queue.py); without it (legacy producers) the
+    whole batch is abandoned and the server reassigns by timeout."""
+
     batch_id: str
+    position_id: Optional[int] = None
 
 
 class EngineError(Exception):

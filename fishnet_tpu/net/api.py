@@ -22,6 +22,9 @@ from typing import List, Optional
 import aiohttp
 
 from fishnet_tpu import telemetry as _telemetry
+from fishnet_tpu.resilience import accounting as _accounting
+from fishnet_tpu.resilience import faults as _faults
+from fishnet_tpu.resilience.supervisor import CircuitBreaker
 from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 from fishnet_tpu.protocol.types import (
     Acquired,
@@ -40,6 +43,16 @@ from fishnet_tpu.version import PROTOCOL_VERSION, user_agent
 
 REQUEST_TIMEOUT_SECONDS = 30.0  # api.rs:527
 POOL_IDLE_TIMEOUT_SECONDS = 25.0  # api.rs:528
+
+#: Transport attempts for a FINAL analysis submission (and for move
+#: submissions) before the batch is abandoned to the server's timeout.
+#: Progress reports are never retried — they are redundant by design.
+MAX_SUBMIT_ATTEMPTS = 4
+
+#: Circuit-breaker tuning (doc/resilience.md). Env-overridable so the
+#: soak harness and tests can exercise the breaker quickly.
+BREAKER_THRESHOLD_ENV = "FISHNET_BREAKER_THRESHOLD"
+BREAKER_COOLDOWN_ENV = "FISHNET_BREAKER_COOLDOWN"
 
 # Server-traffic telemetry (doc/observability.md). Recorded
 # unconditionally: one histogram observe + one counter inc per HTTP
@@ -71,6 +84,26 @@ _SUSPENDED_SECONDS = _telemetry.REGISTRY.counter(
     "fishnet_api_suspended_seconds_total",
     "Cumulative seconds of 429-imposed traffic suspension.",
 )
+_STUB_ERRORS = _telemetry.REGISTRY.counter(
+    "fishnet_api_stub_errors_total",
+    "Stub-side calls resolved as errors and returned to the caller as "
+    "None (the actor already counted the transport error itself).",
+    labelnames=("endpoint",),
+)
+_SUBMIT_RETRIES = _telemetry.REGISTRY.counter(
+    "fishnet_api_submit_retries_total",
+    "Final-submission transport failures that were requeued for retry "
+    "(exactly-once accounting, doc/resilience.md).",
+)
+_SUBMIT_DROPPED = _telemetry.REGISTRY.counter(
+    "fishnet_api_submit_dropped_total",
+    "Final submissions abandoned after exhausting retries (the server "
+    "reassigns the batch by timeout).",
+)
+_PARKED = _telemetry.REGISTRY.gauge(
+    "fishnet_api_parked_submissions",
+    "Analysis submissions parked behind an open circuit breaker.",
+)
 
 
 class KeyError_(Exception):
@@ -86,6 +119,11 @@ class _Message:
     analysis: Optional[List[Optional[AnalysisPartJson]]] = None
     best_move: Optional[str] = None
     slow: bool = False
+    #: True for a COMPLETED analysis (vs a progress report): final
+    #: submissions are retried on transport failure and confirmed into
+    #: the batch ledger; progress reports are fire-and-forget.
+    final: bool = False
+    attempts: int = 0
 
 
 @dataclass
@@ -111,6 +149,7 @@ class ApiStub:
         try:
             return await fut
         except Exception:  # noqa: BLE001
+            _STUB_ERRORS.inc(endpoint="status")
             return None
 
     def abort(self, batch_id: str) -> None:
@@ -122,6 +161,7 @@ class ApiStub:
         try:
             return await fut
         except Exception:  # noqa: BLE001
+            _STUB_ERRORS.inc(endpoint="acquire")
             return None
 
     def submit_analysis(
@@ -129,9 +169,15 @@ class ApiStub:
         batch_id: str,
         flavor: EvalFlavor,
         analysis: List[Optional[AnalysisPartJson]],
+        final: bool = False,
     ) -> None:
+        """``final``: a completed analysis (not a progress report) —
+        retried on transport failure and ledger-confirmed on 2xx."""
         self._queue.put_nowait(
-            _Message("submit_analysis", batch_id=batch_id, flavor=flavor, analysis=analysis)
+            _Message(
+                "submit_analysis", batch_id=batch_id, flavor=flavor,
+                analysis=analysis, final=final,
+            )
         )
 
     async def submit_move_and_acquire(
@@ -144,6 +190,7 @@ class ApiStub:
         try:
             return await fut
         except Exception:  # noqa: BLE001
+            _STUB_ERRORS.inc(endpoint="submit_move")
             return None
 
 
@@ -162,6 +209,25 @@ class ApiActor:
         self.error_backoff = RandomizedBackoff()
         self._session: Optional[aiohttp.ClientSession] = None
         self._stopped = False
+        # Submit-endpoint circuit breaker (doc/resilience.md): repeated
+        # analysis-submission failures open it and park further
+        # submissions instead of burning a 30 s timeout + error backoff
+        # on each; a cooldown later, one probe goes through and a
+        # success drains the parked work. Move submissions are exempt:
+        # they are latency-critical and carry a chained acquire.
+        import os as _os
+
+        self.breaker = CircuitBreaker(
+            failure_threshold=int(
+                _os.environ.get(BREAKER_THRESHOLD_ENV, "5")
+            ),
+            cooldown_seconds=float(
+                _os.environ.get(BREAKER_COOLDOWN_ENV, "30")
+            ),
+            name="submit",
+        )
+        self._parked: List[_Message] = []
+        self._breaker_wake: Optional[asyncio.TimerHandle] = None
 
     def _make_session(self) -> aiohttp.ClientSession:
         headers = {"User-Agent": user_agent()}
@@ -194,10 +260,92 @@ class ApiActor:
                 if self._stopped and self.queue.empty():
                     break
         finally:
+            if self._breaker_wake is not None:
+                self._breaker_wake.cancel()
+                self._breaker_wake = None
+            if self._parked:
+                # Submissions still parked behind an open breaker at
+                # shutdown: account them as abandoned (the server
+                # reassigns by timeout) rather than risking a hung exit
+                # on a dead endpoint.
+                led = _accounting.get()
+                for parked in self._parked:
+                    _SUBMIT_DROPPED.inc()
+                    if parked.final and led is not None and parked.batch_id:
+                        led.record_abandoned(parked.batch_id, "breaker_open")
+                self.logger.error(
+                    f"Dropped {len(self._parked)} parked submission(s) at "
+                    "shutdown (circuit breaker open)."
+                )
+                self._parked.clear()
+                _PARKED.set(0)
             await self._session.close()
             self.logger.debug("Api actor exited")
 
+    # -- circuit breaker plumbing -----------------------------------------
+
+    def _park(self, msg: _Message) -> None:
+        self._parked.append(msg)
+        _PARKED.set(len(self._parked))
+        self._schedule_breaker_wake()
+
+    def _drain_parked(self) -> None:
+        for parked in self._parked:
+            self.queue.put_nowait(parked)
+        self._parked.clear()
+        _PARKED.set(0)
+
+    def _schedule_breaker_wake(self) -> None:
+        """Arm a one-shot wake that re-enqueues one parked submission
+        once the cooldown elapses — the probe that can close the
+        breaker even when no fresh traffic arrives."""
+        if self._breaker_wake is not None or not self._parked:
+            return
+        delay = max(0.05, self.breaker.remaining_cooldown())
+        loop = asyncio.get_running_loop()
+        self._breaker_wake = loop.call_later(delay, self._wake_parked)
+
+    def _wake_parked(self) -> None:
+        self._breaker_wake = None
+        if self._stopped or not self._parked:
+            return
+        probe = self._parked.pop(0)
+        _PARKED.set(len(self._parked))
+        self.queue.put_nowait(probe)
+
+    def _submit_retryable(self, msg: _Message) -> bool:
+        """Messages whose loss would break exactly-once accounting:
+        completed analyses and move submissions. Progress reports are
+        redundant by design and are never retried."""
+        return (msg.kind == "submit_analysis" and msg.final) or (
+            msg.kind == "submit_move"
+        )
+
+    def _retry_or_drop(self, msg: _Message, err: Optional[Exception]) -> bool:
+        """Requeue a failed retryable submission (True) or account the
+        drop (False). Caller resolves the future only on drop."""
+        if msg.attempts + 1 < MAX_SUBMIT_ATTEMPTS:
+            msg.attempts += 1
+            _SUBMIT_RETRIES.inc()
+            self.queue.put_nowait(msg)
+            return True
+        _SUBMIT_DROPPED.inc()
+        led = _accounting.get()
+        if led is not None and msg.batch_id:
+            led.record_abandoned(msg.batch_id, "submit_failed")
+        self.logger.error(
+            f"Dropping {msg.kind} for {msg.batch_id} after "
+            f"{MAX_SUBMIT_ATTEMPTS} attempts ({err!r})."
+        )
+        return False
+
     async def _handle(self, msg: _Message) -> None:
+        if msg.kind == "submit_analysis" and not self.breaker.allow():
+            # Breaker open: park instead of burning a request timeout
+            # plus error backoff against a server that is refusing
+            # submissions. The cooldown wake re-enqueues a probe.
+            self._park(msg)
+            return
         started = time.monotonic()
         try:
             await self._handle_inner(msg)
@@ -207,6 +355,9 @@ class ApiActor:
             _REQUESTS.inc(endpoint=msg.kind, outcome="ok")
             if msg.kind == "acquire" and _telemetry.enabled():
                 _SPANS.record("acquire", started)
+            if msg.kind == "submit_analysis" and self.breaker.record_success():
+                self.logger.info("Submit circuit breaker closed; draining.")
+                self._drain_parked()
             self.error_backoff.reset()
         except asyncio.CancelledError:
             raise
@@ -221,7 +372,13 @@ class ApiActor:
             self.logger.error(
                 f"Too many requests. Suspending requests for {backoff:.1f}s."
             )
-            if msg.future and not msg.future.done():
+            # A rate-limited FINAL submission is requeued (not counted
+            # as a breaker failure: 429 is load shedding, not an
+            # outage) so the batch is not lost to the suspension.
+            retried = self._submit_retryable(msg) and self._retry_or_drop(
+                msg, None
+            )
+            if not retried and msg.future and not msg.future.done():
                 msg.future.set_exception(RateLimited())
             await asyncio.sleep(backoff)
         except Exception as err:  # noqa: BLE001 - any transport/protocol error
@@ -229,9 +386,17 @@ class ApiActor:
                 time.monotonic() - started, endpoint=msg.kind
             )
             _REQUESTS.inc(endpoint=msg.kind, outcome="error")
+            if msg.kind == "submit_analysis" and self.breaker.record_failure():
+                self.logger.error(
+                    "Submit circuit breaker OPEN: parking submissions for "
+                    f"{self.breaker.cooldown_seconds:.0f}s."
+                )
             backoff = self.error_backoff.next()
             self.logger.error(f"{err!r}. Backing off {backoff:.1f}s.")
-            if msg.future and not msg.future.done():
+            retried = self._submit_retryable(msg) and self._retry_or_drop(
+                msg, err
+            )
+            if not retried and msg.future and not msg.future.done():
                 msg.future.set_exception(err)
             await asyncio.sleep(backoff)
 
@@ -264,10 +429,15 @@ class ApiActor:
                 self.logger.error(f"Invalid acquire response: {err}")
                 self._fulfil(msg, Acquired.no_content())
                 return
+            led = _accounting.get()
+            if led is not None:
+                led.record_acquired(body.work.id)
             if not self._fulfil(msg, Acquired.accepted(body)):
                 # Nobody is waiting for this job anymore: abort so the
                 # server can reassign immediately (api.rs:678-684).
                 self.logger.error("Acquired a batch, but callback dropped. Aborting.")
+                if led is not None:
+                    led.record_abandoned(body.work.id, "callback_dropped")
                 await self._abort(body.work.id)
         else:
             self.logger.warn(f"Unexpected status for acquire: {res.status}")
@@ -281,6 +451,14 @@ class ApiActor:
 
     async def _handle_inner(self, msg: _Message) -> None:
         assert self._session is not None
+        if _faults.enabled():
+            # Named injection sites (doc/resilience.md): faults raised
+            # here flow through _handle's real error/backoff machinery,
+            # exactly like a transport failure would.
+            if msg.kind == "acquire":
+                await _faults.fire_async("net.acquire")
+            elif msg.kind in ("submit_analysis", "submit_move"):
+                await _faults.fire_async("net.submit")
         if msg.kind == "check_key":
             async with self._session.get(f"{self.endpoint}/key") as res:
                 if res.status in (200, 204):
@@ -334,6 +512,10 @@ class ApiActor:
                     self.logger.warn(
                         f"Unexpected status for submitting analysis: {res.status}"
                     )
+                if msg.final:
+                    led = _accounting.get()
+                    if led is not None:
+                        led.record_submitted(msg.batch_id)
         elif msg.kind == "submit_move":
             async with self._session.post(
                 f"{self.endpoint}/move/{msg.batch_id}",
@@ -341,7 +523,14 @@ class ApiActor:
             ) as res:
                 if res.status == 429:
                     raise RateLimited()
+                rejected = res.status in (400, 401, 403, 406)
                 await self._parse_acquired(res, msg)
+                led = _accounting.get()
+                if led is not None:
+                    if rejected:
+                        led.record_abandoned(msg.batch_id, "rejected")
+                    else:
+                        led.record_submitted(msg.batch_id)
         else:
             raise AssertionError(f"unknown message kind {msg.kind}")
 

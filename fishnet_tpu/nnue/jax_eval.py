@@ -335,6 +335,8 @@ def evaluate_packed_anchored(
     anchor_tab: jax.Array,
     n_rows: jax.Array,
     psqt_tab: jax.Array,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
 ):
     """evaluate_batch over the compact wire with PERSISTENT device-
     resident anchors (VERDICT r4 item 1): ``anchor_tab`` [A, 2, L1]
@@ -363,6 +365,14 @@ def evaluate_packed_anchored(
     whose contents can exceed the weight-table bounds (out-of-bounds
     DMAs in the fused kernel), so every offset clamps to ``n_rows``,
     where the service writes one sentinel block.
+
+    ``use_pallas`` / ``interpret`` (static under jit) pin the
+    feature-transformer executor instead of ft_accumulate's
+    auto-selection — the degradation ladder's seam
+    (resilience/supervisor.py): ``use_pallas=False`` forces the
+    bit-identical XLA twin; ``interpret=True`` realizes the fused
+    kernel in Pallas interpreter mode on non-TPU backends (the PR 2
+    parity fixtures' venue).
     """
     from fishnet_tpu.ops.ft_gather import decode_parent, ft_accumulate
 
@@ -377,6 +387,8 @@ def evaluate_packed_anchored(
             params["ft_w"],
             params["ft_b"],
             dense,
+            use_pallas=use_pallas,
+            interpret=interpret,
             delta_base=spec.DELTA_BASE,
             parent=parent,
             anchor_tab=anchor_tab,
@@ -388,6 +400,8 @@ def evaluate_packed_anchored(
             params["ft_w"],
             params["ft_b"],
             dense,
+            use_pallas=use_pallas,
+            interpret=interpret,
             delta_base=spec.DELTA_BASE,
             parent=parent,
             anchor_tab=anchor_tab,
@@ -414,7 +428,9 @@ def evaluate_packed_anchored(
 #: instead of copying every step (callers must rebind their handles to
 #: the returned tables — the input buffers are dead after the call).
 evaluate_packed_anchored_jit = jax.jit(
-    evaluate_packed_anchored, donate_argnums=(5, 7)
+    evaluate_packed_anchored,
+    donate_argnums=(5, 7),
+    static_argnames=("use_pallas", "interpret"),
 )
 
 

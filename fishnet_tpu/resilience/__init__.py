@@ -1,0 +1,37 @@
+"""Resilience subsystem: deterministic fault injection, the degradation
+ladder, and exactly-once batch accounting.
+
+Three planes, one discipline (doc/resilience.md):
+
+* :mod:`fishnet_tpu.resilience.faults` — a seedable, deterministic
+  fault plane with named injection sites registered at the serving
+  chokepoints (``net.acquire``, ``net.submit``, ``engine.spawn``,
+  ``service.device_step``, ``queue.schedule``). Plans come from
+  ``FISHNET_FAULT_PLAN`` / ``--fault-plan``; when no plan is installed
+  every site costs one module-attribute read (the same gating
+  discipline as ``telemetry.enabled()``).
+* :mod:`fishnet_tpu.resilience.supervisor` — the degradation ladder
+  (fused Pallas → XLA twin → host-material wire, reusing the service's
+  ``psqt_path`` lattice), bounded pool respawns, and the
+  submit-endpoint circuit breaker.
+* :mod:`fishnet_tpu.resilience.accounting` — the batch ledger
+  (acquired → scheduled → stepped → submitted, with requeue
+  generations) asserting no batch is lost or double-submitted, plus
+  ``python -m fishnet_tpu.resilience.soak``, the harness that drives
+  the fake server + mock engine under canned fault plans.
+
+Everything is **off by default**: with no fault plan installed, no
+ledger installed, and no supervisor wrapped around the service builder,
+the serving hot paths are unchanged.
+"""
+
+from __future__ import annotations
+
+from fishnet_tpu.resilience import accounting, faults  # noqa: F401
+from fishnet_tpu.resilience.faults import (  # noqa: F401 - public API
+    SITES,
+    FaultCrash,
+    FaultInjected,
+    FaultPlan,
+    FaultPlanError,
+)

@@ -1,0 +1,301 @@
+"""Soak harness: drive the fake server + mock engine + real search
+service under a canned fault plan and assert the resilience contract.
+
+Run it from a repo checkout::
+
+    python -m fishnet_tpu.resilience.soak            # canned plan
+    python -m fishnet_tpu.resilience.soak --plan 'seed=1;net.acquire:p=0.2:error'
+
+Two phases, one process, one metrics registry:
+
+* **Phase A (client)** — a full Client (API actor, queue actor, worker
+  pool, mock engine) against the in-process fake lichess, under
+  acquire flaps, submit failures (opening the circuit breaker), and an
+  engine-spawn fault (exercising position requeue). The batch ledger
+  must end clean: every acquired batch submitted exactly once, nothing
+  lost, nothing duplicated — client-side (ledger) AND server-side
+  (per-batch submission counts).
+* **Phase B (service)** — the supervised TpuNnueEngineFactory: the
+  first device dispatch crashes the driver (``service.device_step``
+  fault), the supervisor respawns the pool one rung down the
+  degradation ladder (fused → xla), and the retried search succeeds.
+
+The run ends with a ``/metrics`` scrape asserting the four resilience
+metric families are exported (doc/resilience.md contract):
+``fishnet_faults_injected_total``, ``fishnet_degradations_total``,
+``fishnet_batches_requeued_total``, ``fishnet_breaker_state``.
+
+``make soak-smoke`` runs this via tests/test_soak.py as a tier-1 gate
+(≤ 60 s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib.util
+import json
+import os
+import sys
+import time
+import urllib.request
+from pathlib import Path
+from typing import Dict, Optional
+
+#: The canned plan (ISSUE 4 acceptance): acquire flaps, submit failures
+#: (breaker), one engine crash, one device_step failure.
+CANNED_PLAN = (
+    "seed=7;"
+    "net.acquire:nth=2:error;net.acquire:nth=3:error;"
+    "net.submit:nth=1..2:error;"
+    "engine.spawn:nth=1:error;"
+    "service.device_step:nth=1:crash"
+)
+
+#: The resilience metric-family contract the final scrape must include.
+REQUIRED_FAMILIES = (
+    "fishnet_faults_injected_total",
+    "fishnet_degradations_total",
+    "fishnet_batches_requeued_total",
+    "fishnet_breaker_state",
+)
+
+_START_FEN = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+def _load_fake_server():
+    """Import tests/fake_server.py from the repo checkout (the soak is a
+    development harness; it has no meaning against a real server)."""
+    root = Path(__file__).resolve().parents[2]
+    path = root / "tests" / "fake_server.py"
+    if not path.exists():
+        raise SystemExit(
+            "soak needs a repo checkout: tests/fake_server.py not found "
+            f"under {root}"
+        )
+    spec = importlib.util.spec_from_file_location("_fishnet_soak_fake", path)
+    mod = importlib.util.module_from_spec(spec)
+    # Register before exec: dataclass processing looks the module up in
+    # sys.modules while the class bodies execute.
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+async def _phase_a_client(fake_server_mod, logger, report: Dict) -> None:
+    """Full client loop under acquire/submit/spawn faults."""
+    from fishnet_tpu.client import Client
+    from fishnet_tpu.engine.mock import MockEngineFactory
+
+    t0 = time.monotonic()
+    async with fake_server_mod.FakeServer() as server:
+        moves = ("e2e4 e7e5", "d2d4 d7d5", "g1f3 g8f6", "c2c4 c7c5")
+        job_ids = [
+            server.lichess.add_analysis_job(moves=m, nodes=2000)
+            for m in moves
+        ]
+        client = Client(
+            endpoint=server.endpoint,
+            key=fake_server_mod.VALID_KEY,
+            cores=2,
+            engine_factory=MockEngineFactory(),
+            logger=logger,
+            max_backoff=0.2,
+            batch_deadline=30.0,
+        )
+        await client.start()
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            if all(j in server.lichess.analyses for j in job_ids):
+                break
+            await asyncio.sleep(0.05)
+        await client.stop(abort_pending=False)
+        report["phase_a"] = {
+            "jobs": len(job_ids),
+            "analyses": sum(
+                1 for j in job_ids if j in server.lichess.analyses
+            ),
+            "server_submission_counts": dict(
+                server.lichess.analysis_submission_counts
+            ),
+            "seconds": round(time.monotonic() - t0, 2),
+        }
+        counts = server.lichess.analysis_submission_counts
+        if not all(j in server.lichess.analyses for j in job_ids):
+            raise AssertionError(
+                f"phase A incomplete: {report['phase_a']}"
+            )
+        dupes = {j: c for j, c in counts.items() if c != 1}
+        if dupes:
+            raise AssertionError(
+                f"server saw non-exactly-once submissions: {dupes}"
+            )
+
+
+async def _phase_b_service(logger, report: Dict) -> None:
+    """Supervised service: device_step crash -> respawn one rung down."""
+    from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.protocol.types import EngineFlavor
+    from fishnet_tpu.resilience.supervisor import ServiceSupervisor
+    from fishnet_tpu.search.service import SearchService
+
+    t0 = time.monotonic()
+    weights = NnueWeights.random(seed=0)
+
+    def builder(rung: Optional[str]):
+        return SearchService(
+            weights=weights, pool_slots=16, batch_capacity=64,
+            tt_bytes=8 << 20, backend="jax", psqt_path=rung,
+        )
+
+    supervisor = ServiceSupervisor(
+        builder, start_rung="fused", degrade_after=1, logger=logger
+    )
+    factory = TpuNnueEngineFactory(service_builder=supervisor.build)
+    try:
+        engine = await factory.create(EngineFlavor.OFFICIAL)
+        assert engine.service.psqt_path == "fused", engine.service.psqt_path
+        crashed = False
+        try:
+            await engine.service.search(_START_FEN, [], depth=2)
+        except Exception:  # noqa: BLE001 - the injected crash, by design
+            crashed = True
+        if not crashed:
+            raise AssertionError("device_step crash fault did not fire")
+        # The worker-restart path: create() sees the dead service and
+        # rebuilds through the supervisor (respawn + ladder step).
+        engine = await factory.create(EngineFlavor.OFFICIAL)
+        assert engine.service.psqt_path == "xla", engine.service.psqt_path
+        res = await engine.service.search(_START_FEN, [], depth=2)
+        if not res.best_move:
+            raise AssertionError("degraded service produced no move")
+    finally:
+        factory.close()
+    report["phase_b"] = {
+        "rung": supervisor.rung,
+        "respawns": supervisor.respawns,
+        "device_failures": supervisor.device_failures,
+        "seconds": round(time.monotonic() - t0, 2),
+    }
+
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ) as res:
+        return res.read().decode()
+
+
+async def run_soak(
+    plan_spec: str = CANNED_PLAN,
+    metrics_port: int = 0,
+) -> Dict:
+    """Run both phases under ``plan_spec``; returns the report dict
+    (key ``ok``). Raises AssertionError on a contract violation."""
+    from fishnet_tpu import telemetry
+    from fishnet_tpu.net import api as api_mod
+    from fishnet_tpu.resilience import accounting, faults
+    from fishnet_tpu.resilience import supervisor as supervisor_mod
+    from fishnet_tpu.sched import queue as queue_mod
+    from fishnet_tpu.utils.logger import Logger
+
+    fake_server_mod = _load_fake_server()
+    logger = Logger(verbose=0)
+    report: Dict = {"plan": plan_spec, "ok": False}
+
+    # Counter baselines: the registry is process-wide and cumulative, so
+    # the soak asserts DELTAS (it may run after other traffic in-process).
+    base = {
+        "requeued": queue_mod._REQUEUED.value(),
+        "respawns": supervisor_mod._RESPAWNS.value(),
+    }
+
+    exporter = telemetry.start_exporter(metrics_port)
+    saved_env = {
+        k: os.environ.get(k)
+        for k in (
+            api_mod.BREAKER_THRESHOLD_ENV,
+            api_mod.BREAKER_COOLDOWN_ENV,
+            "FISHNET_SPANS_FILE",
+        )
+    }
+    os.environ[api_mod.BREAKER_THRESHOLD_ENV] = "2"
+    os.environ[api_mod.BREAKER_COOLDOWN_ENV] = "0.75"
+    # Crash/close span dumps go to a scratch path, not the working dir.
+    import tempfile
+
+    spans_file = Path(tempfile.gettempdir()) / f"fishnet-soak-{os.getpid()}.jsonl"
+    os.environ["FISHNET_SPANS_FILE"] = str(spans_file)
+    try:
+        faults.install(plan_spec)
+        ledger = accounting.install()
+        await _phase_a_client(fake_server_mod, logger, report)
+        await _phase_b_service(logger, report)
+
+        report["ledger"] = ledger.assert_clean()
+        report["counters"] = {
+            "faults_injected": faults.current().counts(),
+            "requeued": queue_mod._REQUEUED.value() - base["requeued"],
+            "respawns": supervisor_mod._RESPAWNS.value() - base["respawns"],
+            "degradations_fused_to_xla": supervisor_mod._DEGRADATIONS.value(
+                **{"from": "fused", "to": "xla"}
+            ),
+        }
+        if report["counters"]["requeued"] < 1:
+            raise AssertionError("no batch requeue observed")
+        if report["counters"]["respawns"] < 1:
+            raise AssertionError("no pool respawn observed")
+        if report["counters"]["degradations_fused_to_xla"] < 1:
+            raise AssertionError("no fused->xla degradation observed")
+
+        text = _scrape(exporter.port)
+        missing = [f for f in REQUIRED_FAMILIES if f"# TYPE {f} " not in text]
+        report["metric_families"] = sorted(REQUIRED_FAMILIES)
+        if missing:
+            raise AssertionError(f"/metrics missing families: {missing}")
+        report["ok"] = True
+        return report
+    finally:
+        faults.clear()
+        accounting.clear()
+        exporter.close()
+        telemetry.disable()
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fishnet_tpu.resilience.soak",
+        description="Resilience soak: fake server + client + supervised "
+        "service under a deterministic fault plan.",
+    )
+    parser.add_argument(
+        "--plan", default=CANNED_PLAN,
+        help="fault plan (doc/resilience.md grammar); default: the "
+        "canned acceptance plan",
+    )
+    parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="telemetry port for the run (0 = ephemeral)",
+    )
+    args = parser.parse_args(argv)
+    from fishnet_tpu.resilience.faults import FaultPlanError
+
+    try:
+        report = asyncio.run(
+            run_soak(plan_spec=args.plan, metrics_port=args.metrics_port)
+        )
+    except (AssertionError, FaultPlanError) as err:
+        print(f"SOAK FAILED: {err}", file=sys.stderr)
+        return 1
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,11 +26,13 @@ import asyncio
 import time
 import weakref
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
 
 from fishnet_tpu import telemetry as _telemetry
 from fishnet_tpu.chess import Board, InvalidFenError, UnsupportedVariantError
+from fishnet_tpu.resilience import accounting as _accounting
+from fishnet_tpu.resilience import faults as _faults
 from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 from fishnet_tpu.ipc import Position, PositionFailed, PositionResponse
 from fishnet_tpu.net.api import ApiStub
@@ -46,6 +48,32 @@ from fishnet_tpu.protocol.types import (
 from fishnet_tpu.utils.backoff import RandomizedBackoff
 from fishnet_tpu.utils.logger import Logger, ProgressAt, QueueStatusBar
 from fishnet_tpu.utils.stats import NpsRecorder, Stats, StatsRecorder
+
+
+#: How many times a batch may be requeued after position failures
+#: before it is abandoned to the server's reassignment timeout. Caps
+#: the retry loop a deterministically-failing position would otherwise
+#: spin forever (doc/resilience.md).
+MAX_REQUEUE_GENERATIONS = 2
+
+_REQUEUED = _telemetry.REGISTRY.counter(
+    "fishnet_batches_requeued_total",
+    "Failed positions re-queued for retry (bounded generations).",
+)
+_FLUSHED = _telemetry.REGISTRY.counter(
+    "fishnet_batches_flushed_total",
+    "Batches flushed as partial analyses by the per-batch deadline "
+    "budget.",
+)
+_ABANDONED = _telemetry.REGISTRY.counter(
+    "fishnet_batches_abandoned_total",
+    "Batches abandoned to the server's reassignment timeout.",
+    labelnames=("reason",),
+)
+_QUEUE_ERRORS = _telemetry.REGISTRY.counter(
+    "fishnet_queue_exceptions_total",
+    "Unexpected exceptions caught (and survived) by the queue actor.",
+)
 
 
 class _Skip:
@@ -171,9 +199,28 @@ class PendingBatch:
     positions: List[object]
     started_at: float
     url: Optional[str] = None
+    #: The original Position per index (SKIP for skipped) so a failed
+    #: position can be re-queued without re-expanding the batch.
+    sources: List[object] = field(default_factory=list)
+    #: Requeue generation (bounded by MAX_REQUEUE_GENERATIONS).
+    generation: int = 0
 
     def pending(self) -> int:
         return sum(1 for p in self.positions if p is None)
+
+    def into_partial_completed(self, now: float) -> "CompletedBatch":
+        """Deadline-flush view: everything not yet analysed reports as
+        skipped — lila accepts skipped parts, and a partial analysis
+        beats wedging the queue behind a hung position."""
+        return CompletedBatch(
+            work=self.work,
+            flavor=self.flavor,
+            variant=self.variant,
+            positions=[SKIP if p is None else p for p in self.positions],
+            started_at=self.started_at,
+            completed_at=now,
+            url=self.url,
+        )
 
     def try_into_completed(self) -> Optional["CompletedBatch"]:
         if any(p is None for p in self.positions):
@@ -290,7 +337,13 @@ def _register_queue_collector(state: "QueueState") -> int:
 
 
 class QueueState:
-    def __init__(self, cores: int, stats: StatsRecorder, logger: Logger) -> None:
+    def __init__(
+        self,
+        cores: int,
+        stats: StatsRecorder,
+        logger: Logger,
+        batch_deadline: Optional[float] = None,
+    ) -> None:
         self.shutdown_soon = False
         self.cores = cores
         self.incoming: Deque[Position] = deque()
@@ -298,6 +351,59 @@ class QueueState:
         self.move_submissions: Deque[CompletedBatch] = deque()
         self.stats_recorder = stats
         self.logger = logger
+        #: Per-batch deadline budget (seconds); None = no deadline.
+        self.batch_deadline = batch_deadline
+
+    def flush_expired(self, api: ApiStub) -> int:
+        """Enforce the per-batch deadline budget: analysis batches older
+        than the budget are submitted PARTIALLY (unanalysed plies marked
+        skipped); expired move jobs are aborted (a stale move is
+        useless). Cheap when nothing is pending or no deadline is set;
+        called from the worker-pull hot points so one hung engine can
+        never wedge every other batch behind it."""
+        if self.batch_deadline is None or not self.pending:
+            return 0
+        now = time.monotonic()
+        flushed = 0
+        for batch_id in list(self.pending):
+            batch = self.pending[batch_id]
+            if now - batch.started_at <= self.batch_deadline:
+                continue
+            del self.pending[batch_id]
+            self.incoming = deque(
+                p for p in self.incoming if p.work.id != batch_id
+            )
+            led = _accounting.get()
+            if batch.work.is_analysis:
+                _FLUSHED.inc()
+                if led is not None:
+                    led.record_flushed(batch_id)
+                completed = batch.into_partial_completed(now)
+                done = sum(
+                    1 for p in completed.positions
+                    if isinstance(p, PositionResponse)
+                )
+                self.logger.error(
+                    f"Batch {batch.url or batch_id} exceeded its "
+                    f"{self.batch_deadline:.0f}s deadline; flushing "
+                    f"{done}/{len(completed.positions)} analysed plies."
+                )
+                api.submit_analysis(
+                    completed.work.id,
+                    completed.flavor.eval_flavor(),
+                    completed.into_analysis(),
+                    final=True,
+                )
+            else:
+                _ABANDONED.inc(reason="deadline")
+                if led is not None:
+                    led.record_abandoned(batch_id, "deadline")
+                self.logger.error(
+                    f"Move job {batch_id} exceeded its deadline; aborting."
+                )
+                api.abort(batch_id)
+            flushed += 1
+        return flushed
 
     def status_bar(self) -> QueueStatusBar:
         return QueueStatusBar(
@@ -335,7 +441,11 @@ class QueueState:
             positions=placeholders,
             started_at=time.monotonic(),
             url=batch.url,
+            sources=list(batch.positions),
         )
+        led = _accounting.get()
+        if led is not None:
+            led.record_scheduled(batch_id)
         self.logger.progress(
             self.status_bar(), ProgressAt(batch_id=batch_id, batch_url=batch.url)
         )
@@ -371,6 +481,10 @@ class QueueStub:
     async def pull(self, pull: Pull) -> None:
         if pull.response is not None:
             self._handle_position_response(pull.response)
+        # Deadline budget: every worker handoff checks for expired
+        # batches, so a single hung engine cannot wedge the rest of the
+        # queue behind its batch.
+        self._state.flush_expired(self._api)
         if self._state.try_pull(pull.callback):
             return
         if self._state.shutdown_soon and not self._state.incoming:
@@ -387,17 +501,15 @@ class QueueStub:
     def _handle_position_response(self, res: object) -> None:
         state = self._state
         if isinstance(res, PositionFailed):
-            # Forget the batch; the server will reassign it by timeout
-            # rather than us handing back known-bad work (queue.rs:207-214).
-            state.pending.pop(res.batch_id, None)
-            state.incoming = deque(
-                p for p in state.incoming if p.work.id != res.batch_id
-            )
+            self._handle_position_failed(res)
             return
         assert isinstance(res, PositionResponse)
         batch = state.pending.get(res.work.id)
         if batch is not None and 0 <= res.position_id < len(batch.positions):
             batch.positions[res.position_id] = res
+            led = _accounting.get()
+            if led is not None:
+                led.record_stepped(res.work.id)
         state.logger.progress(
             state.status_bar(),
             ProgressAt(
@@ -405,6 +517,58 @@ class QueueStub:
             ),
         )
         self._maybe_finished(res.work.id)
+
+    def _handle_position_failed(self, res: PositionFailed) -> None:
+        """Requeue a failed position (bounded generations) instead of
+        abandoning the whole batch on the first transient engine
+        failure. The requeued position goes to the FRONT of the
+        incoming queue so an older batch's retry is served before fresh
+        acquires' positions (acquire order preserved — a failed batch
+        can no longer starve behind new work). Producers that do not
+        identify the position (legacy PositionFailed without
+        position_id), and batches over the generation cap, keep the
+        reference behavior: abandon silently, the server reassigns by
+        timeout (queue.rs:207-214)."""
+        state = self._state
+        batch = state.pending.get(res.batch_id)
+        if batch is None:
+            return
+        src = None
+        if res.position_id is not None and (
+            0 <= res.position_id < len(batch.sources)
+        ):
+            src = batch.sources[res.position_id]
+        led = _accounting.get()
+        if (
+            src is None
+            or src is SKIP
+            or batch.generation >= MAX_REQUEUE_GENERATIONS
+        ):
+            reason = (
+                "requeue_cap" if batch.generation >= MAX_REQUEUE_GENERATIONS
+                else "position_failed"
+            )
+            state.pending.pop(res.batch_id, None)
+            state.incoming = deque(
+                p for p in state.incoming if p.work.id != res.batch_id
+            )
+            _ABANDONED.inc(reason=reason)
+            if led is not None:
+                led.record_abandoned(res.batch_id, reason)
+            state.logger.warn(
+                f"Abandoning batch {batch.url or res.batch_id} ({reason}); "
+                "the server will reassign it."
+            )
+            return
+        batch.generation += 1
+        _REQUEUED.inc()
+        if led is not None:
+            led.record_requeued(res.batch_id, batch.generation)
+        state.incoming.appendleft(src)
+        state.logger.debug(
+            f"Requeued position {res.position_id} of {res.batch_id} "
+            f"(generation {batch.generation}/{MAX_REQUEUE_GENERATIONS})."
+        )
 
     def _maybe_finished(self, batch_id: str) -> None:
         state = self._state
@@ -447,6 +611,7 @@ class QueueStub:
                 completed.work.id,
                 completed.flavor.eval_flavor(),
                 completed.into_analysis(),
+                final=True,
             )
         else:
             state.logger.debug(log)
@@ -467,8 +632,11 @@ class QueueStub:
 
     def shutdown(self) -> None:
         self.shutdown_soon()
+        led = _accounting.get()
         for batch_id in list(self._state.pending):
             del self._state.pending[batch_id]
+            if led is not None:
+                led.record_abandoned(batch_id, "shutdown_abort")
             self._api.abort(batch_id)
 
     def stats(self) -> Tuple[Stats, NpsRecorder]:
@@ -537,22 +705,34 @@ class QueueActor:
         tel = _telemetry.enabled()
         t0 = time.monotonic() if tel else 0.0
         try:
+            # "queue.schedule" fault site: a failure here is a
+            # trust-boundary failure — the batch is dropped like an
+            # invalid one and the server reassigns by timeout.
+            if _faults.enabled():
+                await _faults.fire_async("queue.schedule")
             incoming = IncomingBatch.from_acquired(self.api.endpoint, body)
         except AllSkipped as all_skipped:
             self.logger.warn(f"Completed empty batch {context}.")
             completed = all_skipped.completed
+            led = _accounting.get()
+            if led is not None:
+                led.record_scheduled(completed.work.id)
             self.api.submit_analysis(
                 completed.work.id,
                 completed.flavor.eval_flavor(),
                 completed.into_analysis(),
+                final=True,
             )
             if tel:
                 _SPANS.record(
                     "schedule", t0, batch=context, outcome="all_skipped"
                 )
             return
-        except IncomingError as err:
+        except (IncomingError, _faults.FaultInjected) as err:
             self.logger.warn(f"Ignoring invalid batch {context}: {err}")
+            led = _accounting.get()
+            if led is not None:
+                led.record_invalid(context, str(err))
             if tel:
                 _SPANS.record("schedule", t0, batch=context, outcome="invalid")
             return
@@ -596,6 +776,7 @@ class QueueActor:
                 except asyncio.CancelledError:
                     raise
                 except Exception as err:  # noqa: BLE001 - keep the queue alive
+                    _QUEUE_ERRORS.inc()
                     self.logger.error(f"Queue error: {err!r}")
                     if not callback.done():
                         callback.cancel()
@@ -612,6 +793,7 @@ class QueueActor:
     async def _pull_loop(self, callback: asyncio.Future) -> None:
         while True:
             await self.handle_move_submissions()
+            self.state.flush_expired(self.api)
 
             if self.state.try_pull(callback):
                 return
@@ -658,11 +840,13 @@ def channel(
     stats: Optional[StatsRecorder] = None,
     backlog: Optional[BacklogOpt] = None,
     max_backoff: float = 30.0,
+    batch_deadline: Optional[float] = None,
 ) -> Tuple[QueueStub, QueueActor]:
     rx: "asyncio.Queue" = asyncio.Queue()
     interrupt = asyncio.Event()
     state = QueueState(
-        cores, stats or StatsRecorder(cores, no_stats_file=True), logger
+        cores, stats or StatsRecorder(cores, no_stats_file=True), logger,
+        batch_deadline=batch_deadline,
     )
     _register_queue_collector(state)
     stub = QueueStub(rx, interrupt, state, api)

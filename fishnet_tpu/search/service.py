@@ -35,6 +35,7 @@ import numpy as np
 
 from fishnet_tpu import telemetry as _telemetry
 from fishnet_tpu.chess.board import _VARIANT_CODES
+from fishnet_tpu.resilience import faults as _faults
 from fishnet_tpu.chess.core import NativeCoreError, load
 from fishnet_tpu.protocol.types import Variant
 from fishnet_tpu.nnue import spec
@@ -285,6 +286,13 @@ def _register_service_collector(svc: "SearchService") -> int:
     return _telemetry.REGISTRY.register_collector(collect, name="search-service")
 
 
+_LISTENER_ERRORS = _telemetry.REGISTRY.counter(
+    "fishnet_service_listener_errors_total",
+    "failure_listener callbacks that raised during driver-crash "
+    "teardown (swallowed so the original crash stays visible).",
+)
+
+
 #: Must cover the native core's largest single eval block
 #: (cpp/src/search.h:32 EVAL_BLOCK_MAX): emit_block is all-or-nothing, so
 #: a capacity below one block would never fit it and the fiber would wait
@@ -307,6 +315,7 @@ class SearchService:
         pipeline_depth: int = 1,
         evaluator=None,
         driver_threads: int = 1,
+        psqt_path: Optional[str] = None,
     ) -> None:
         """``evaluator``: optional callable ``(params, indices, buckets) ->
         int32 [B]`` replacing the built-in single-device
@@ -314,7 +323,19 @@ class SearchService:
         ``parallel.mesh.ShardedEvaluator`` shards each microbatch over a
         device mesh). Its optional ``size_multiple`` attribute forces
         every eval-size bucket to a multiple so sharded batches split
-        evenly across devices."""
+        evenly across devices.
+
+        ``psqt_path``: request a rung of the eval-path lattice instead
+        of auto-selection — the degradation ladder's seam
+        (resilience/supervisor.py). ``"fused"`` pins the fused Pallas
+        kernel (realized in interpreter mode off-TPU, the parity
+        fixtures' venue); ``"xla"`` pins the bit-identical XLA twin;
+        ``"host-material"`` restores the legacy host-material wire.
+        All rungs produce bit-identical analysis output; only the
+        builtin single-device evaluator honors the request (sharded
+        meshes always run host-material)."""
+        if psqt_path not in (None, "fused", "xla", "host-material"):
+            raise ValueError(f"unknown psqt_path request: {psqt_path!r}")
         self._lib = load()
         _bind_pool_api(self._lib)
 
@@ -501,26 +522,59 @@ class SearchService:
         # tables above), so the host material term leaves the hot wire
         # entirely — 4 bytes/position and one random-gather pass gone.
         # FISHNET_HOST_MATERIAL=1 restores the legacy host-material wire
-        # (the CPU/XLA fallback term the pool still computes).
-        self._device_psqt = self._packed_wire and (
-            os.environ.get("FISHNET_HOST_MATERIAL", "0") != "1"
-        )
+        # (the CPU/XLA fallback term the pool still computes). An
+        # explicit ``psqt_path`` request (the degradation ladder) wins
+        # over both the env var and auto-selection.
+        if not self._packed_wire:
+            requested = None  # external evaluators: host-material only
+        else:
+            requested = psqt_path
+        if requested is None:
+            self._device_psqt = self._packed_wire and (
+                os.environ.get("FISHNET_HOST_MATERIAL", "0") != "1"
+            )
+        else:
+            self._device_psqt = requested != "host-material"
+        # (use_pallas, interpret) pinning for the anchored eval path;
+        # None = ft_accumulate auto-selects (fused on conforming TPU
+        # backends, XLA twin elsewhere).
+        self._eval_force = None
         if not self._packed_wire:
             # External evaluators (sharded meshes, test doubles) keep
             # the host-material wire.
             self.psqt_path = "host-material"
         elif not self._device_psqt:
             self.psqt_path = "host-material"
+            if requested == "host-material":
+                # Pin the executor too: the forced-host rung must not
+                # silently resurrect the fused kernel for the FT pass.
+                self._eval_force = (False, False)
         else:
             import jax
 
-            # Which executor serves the device PSQT: the fused Pallas
-            # kernel on conforming TPU backends, the bit-identical XLA
-            # fallback elsewhere (mirrors ft_gather's auto-select).
-            self.psqt_path = (
-                "fused"
-                if jax.default_backend() == "tpu" and spec.L1 % 1024 == 0
-                else "xla"
+            on_tpu = jax.default_backend() == "tpu" and spec.L1 % 1024 == 0
+            if requested == "xla":
+                self.psqt_path = "xla"
+                self._eval_force = (False, False)
+            elif requested == "fused":
+                # Off-TPU the fused kernel is realized in Pallas
+                # interpreter mode — slow but bit-identical, the PR 2
+                # parity fixtures' venue. The rung stays honest: what
+                # runs IS the fused kernel.
+                self.psqt_path = "fused"
+                self._eval_force = (True, False) if on_tpu else (False, True)
+            else:
+                # Which executor serves the device PSQT: the fused
+                # Pallas kernel on conforming TPU backends, the
+                # bit-identical XLA fallback elsewhere (mirrors
+                # ft_gather's auto-select).
+                self.psqt_path = "fused" if on_tpu else "xla"
+        if self._packed_wire and self._eval_force is not None:
+            import functools
+
+            up, interp = self._eval_force
+            self._eval_fn = functools.partial(
+                self._eval_fn, use_pallas=up, interpret=interp
             )
         self._packed_buf = np.empty((k, 4 * cap + 4, 2, 8), dtype=np.uint16)
         self._offset_buf = np.empty((k, cap), dtype=np.int32)
@@ -557,6 +611,10 @@ class SearchService:
         self._lock = threading.Lock()
         self._warmup_lock = threading.Lock()
         self._warmed = False
+        #: Optional crash hook (resilience/supervisor.py installs its
+        #: ladder bookkeeping here): called from a dying driver thread
+        #: with the fatal exception, BEFORE the futures are failed.
+        self.failure_listener = None
         self._wakes = [threading.Event() for _ in range(T)]
         self._rr = 0  # round-robin submission cursor over threads
         self._stopping = False
@@ -639,6 +697,11 @@ class SearchService:
         the LARGEST entry bucket (where the payload matters) gets the
         finer tiers — small buckets are base-RTT-dominated anyway."""
         if self._packed_wire and size == self._eval_sizes[-1]:
+            if self._eval_force is not None and self._eval_force[1]:
+                # Interpreter-mode realization (forced "fused" rung
+                # off-TPU): each tier costs ~10 s of interpret compile,
+                # so ship everything in the one all-full tier.
+                return [4 * size + 4]
             return [2 * size + 4, 3 * size + 4, 4 * size + 4]
         return [4 * size + 4]
 
@@ -982,6 +1045,12 @@ class SearchService:
         try:
             self._drive_inner(t)
         except Exception as err:  # noqa: BLE001 - driver must not die silently
+            listener = self.failure_listener
+            if listener is not None:
+                try:
+                    listener(err)
+                except Exception:  # noqa: BLE001 - listener must not mask the crash
+                    _LISTENER_ERRORS.inc()
             # Flag first so sibling threads stop too, then fail this
             # thread's own futures (each sibling fails its own on exit).
             # stop_all unsticks siblings BLOCKED inside a long native
@@ -1165,6 +1234,12 @@ class SearchService:
                 if n > 0:
                     if self._eval_fn is None:
                         raise NativeCoreError("no evaluator")  # pragma: no cover
+                    # "service.device_step" fault site: an injected
+                    # error/crash takes this driver down exactly like a
+                    # real dispatch failure would — the supervisor's
+                    # respawn + degradation ladder is the recovery.
+                    if _faults.enabled():
+                        _faults.fire("service.device_step")
                     t0 = time.monotonic() if tel else 0.0
                     inflight[g] = (n, self._dispatch_eval(g, n, rows.value))
                     if tel:

@@ -2,7 +2,8 @@
 stages, kept in fixed-size per-thread ring buffers, dumped as JSONL for
 crash forensics.
 
-The six stage names are a stable contract (doc/observability.md):
+The six pipeline stage names are a stable contract
+(doc/observability.md):
 
 * ``acquire``     — server round-trip acquiring work (net/api.py)
 * ``schedule``    — validate + expand an acquired batch (sched/queue.py)
@@ -10,6 +11,12 @@ The six stage names are a stable contract (doc/observability.md):
 * ``device_step`` — device dispatch of one eval microbatch
 * ``wire_decode`` — blocking on the dispatched array (wire + decode)
 * ``postprocess`` — provide values to fibers + harvest finished slots
+
+plus one *event* stage outside the pipeline (so it appears only when
+recovery machinery actually runs, never on a healthy serve):
+
+* ``recover``     — a supervised service rebuild: respawn and/or
+  degradation-ladder step (resilience/supervisor.py)
 
 Recording is OFF by default: every instrumentation site is gated on
 ``fishnet_tpu.telemetry.enabled()``, so with telemetry disabled the
@@ -35,10 +42,14 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-#: The stage-name contract, in pipeline order.
+#: The pipeline stage-name contract, in pipeline order. (A healthy
+#: serve records exactly these; see EVENT_STAGES for the rest.)
 STAGES = (
     "acquire", "schedule", "pack", "device_step", "wire_decode", "postprocess",
 )
+
+#: Event stages: recorded only when the named machinery runs.
+EVENT_STAGES = ("recover",)
 
 DEFAULT_CAPACITY = 4096  # spans kept per thread
 

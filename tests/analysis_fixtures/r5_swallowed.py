@@ -1,0 +1,66 @@
+"""R5 fixture: swallowed-exception violations at known lines."""
+import asyncio
+
+from fishnet_tpu import telemetry
+
+ERRORS = telemetry.REGISTRY.counter("fx_errors_total", "fixture")
+
+
+def swallow_bare():
+    try:
+        risky()
+    except:  # line 12: bare except, pass-only
+        pass
+
+
+def swallow_broad_logged(logger):
+    try:
+        risky()
+    except Exception as err:  # line 19: log-only is NOT observable
+        logger.error(f"oops: {err!r}")
+
+
+def swallow_tuple():
+    try:
+        risky()
+    except (ValueError, BaseException):  # line 26: broad via tuple
+        return None
+
+
+def handled_reraise():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def handled_counter():
+    try:
+        risky()
+    except Exception:
+        ERRORS.inc()
+
+
+def handled_return_err():
+    try:
+        risky()
+    except Exception as err:
+        return err
+
+
+def handled_future(fut):
+    try:
+        risky()
+    except Exception as err:
+        fut.set_exception(err)
+
+
+def handled_narrow():
+    try:
+        risky()
+    except ValueError:
+        pass  # narrow: catching what you expect is handling
+
+
+def risky():
+    raise ValueError("boom")

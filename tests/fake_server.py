@@ -34,11 +34,18 @@ class FakeLichess:
 
     jobs: List[FakeJob] = field(default_factory=list)
     analyses: Dict[str, List[dict]] = field(default_factory=dict)
+    #: How many times a COMPLETED analysis was received per work id —
+    #: the server-side half of the exactly-once assertion (the
+    #: ``analyses`` dict alone would silently hide duplicates).
+    analysis_submission_counts: Dict[str, int] = field(default_factory=dict)
     progress_reports: Dict[str, List[dict]] = field(default_factory=dict)
     moves: Dict[str, dict] = field(default_factory=dict)
     aborted: List[str] = field(default_factory=list)
     acquire_count: int = 0
     reject_with: Optional[int] = None  # force an HTTP status on acquire
+    #: Fail the next N completed-analysis submissions with HTTP 500
+    #: (exercises the client's submit retry + circuit breaker).
+    fail_submits: int = 0
     status_supported: bool = True
     abort_supported: bool = True
     require_key: bool = True
@@ -141,6 +148,12 @@ class FakeLichess:
         if parts and parts[0] is None:
             self.progress_reports.setdefault(work_id, []).append(body)
         else:
+            if self.fail_submits > 0:
+                self.fail_submits -= 1
+                return web.Response(status=500, text="injected submit failure")
+            self.analysis_submission_counts[work_id] = (
+                self.analysis_submission_counts.get(work_id, 0) + 1
+            )
             self.analyses[work_id] = body
             self.jobs = [j for j in self.jobs if j.body["work"]["id"] != work_id]
         return web.Response(status=204)

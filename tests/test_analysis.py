@@ -17,6 +17,7 @@ from fishnet_tpu.analysis.rules import (
     CrossThreadStateRule,
     DeprecatedJaxRule,
     JitHostSyncRule,
+    SwallowedExceptionRule,
 )
 
 REPO = Path(__file__).resolve().parent.parent
@@ -145,6 +146,43 @@ def test_r4_lock_guarded_class_is_clean():
     assert not any("_queue" in f.message for f in findings)
 
 
+# -- R5 -------------------------------------------------------------------
+
+
+def test_r5_fires_on_known_lines():
+    findings = check_paths(
+        [FIXTURES / "r5_swallowed.py"], [SwallowedExceptionRule()]
+    )
+    assert _lines(findings) == [
+        ("R5", 12),  # bare except, pass-only
+        ("R5", 19),  # except Exception, log-only (logging is invisible
+        #              to the metrics plane — not observable)
+        ("R5", 26),  # broad via tuple element
+    ]
+
+
+def test_r5_exempts_observable_handlers():
+    # raise / counter .inc() / `return err` / set_exception(err) /
+    # narrow types: all handled, none may fire (lines >= 30).
+    findings = check_paths(
+        [FIXTURES / "r5_swallowed.py"], [SwallowedExceptionRule()]
+    )
+    assert all(f.line < 30 for f in findings)
+
+
+def test_r5_scopes_to_serving_layers():
+    # The rule polices fishnet_tpu.net/sched/search (and stand-alone
+    # files); an identical handler in, say, fishnet_tpu.train is out of
+    # scope — broad excepts there have their own idioms (checkpoint
+    # recovery) and their own review.
+    rule = SwallowedExceptionRule()
+    assert rule._SCOPES == (
+        "fishnet_tpu.net", "fishnet_tpu.sched", "fishnet_tpu.search"
+    )
+    findings = check_paths([PACKAGE / "train"], [rule])
+    assert findings == []
+
+
 # -- suppressions ---------------------------------------------------------
 
 
@@ -201,5 +239,5 @@ def test_cli_exit_codes():
         cwd=REPO,
     )
     assert rules.returncode == 0
-    for rid in ("R1", "R2", "R3", "R4"):
+    for rid in ("R1", "R2", "R3", "R4", "R5"):
         assert rid in rules.stdout

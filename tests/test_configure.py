@@ -187,6 +187,53 @@ def test_metrics_port(tmp_path, monkeypatch):
     assert opt.metrics_port is None
 
 
+def test_fault_plan_and_batch_deadline(tmp_path, monkeypatch):
+    monkeypatch.setattr(cfg, "available_cores", lambda: 8)
+    conf = tmp_path / "fishnet.ini"
+    conf.write_text(
+        "[Fishnet]\nKey = k\n"
+        "FaultPlan = seed=1;net.acquire:nth=2:error\n"
+        "BatchDeadline = 2m\n"
+    )
+    opt = cfg.parse_and_configure(
+        ["run", "--conf", str(conf)], output=io.StringIO()
+    )
+    assert opt.fault_plan == "seed=1;net.acquire:nth=2:error"
+    assert opt.resolved_fault_plan() == opt.fault_plan
+    assert opt.batch_deadline == 120.0
+    # CLI wins over ini.
+    opt = cfg.parse_and_configure(
+        ["run", "--conf", str(conf), "--fault-plan",
+         "net.submit:p=0.1:latency=0.01", "--batch-deadline", "30s"],
+        output=io.StringIO(),
+    )
+    assert opt.fault_plan == "net.submit:p=0.1:latency=0.01"
+    assert opt.batch_deadline == 30.0
+    # Defaults: both off; FISHNET_FAULT_PLAN is the env fallback.
+    conf2 = tmp_path / "bare.ini"
+    conf2.write_text("[Fishnet]\nKey = k\n")
+    opt = cfg.parse_and_configure(
+        ["run", "--conf", str(conf2)], output=io.StringIO()
+    )
+    assert opt.fault_plan is None and opt.batch_deadline is None
+    assert opt.resolved_fault_plan() is None
+    monkeypatch.setenv("FISHNET_FAULT_PLAN", "queue.schedule:nth=1:error")
+    assert opt.resolved_fault_plan() == "queue.schedule:nth=1:error"
+
+
+def test_fault_plan_invalid(tmp_path):
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_and_configure(
+            ["run", "--no-conf", "--fault-plan", "nosuch.site:nth=1:error"],
+            output=io.StringIO(),
+        )
+    with pytest.raises(cfg.ConfigError):
+        cfg.parse_and_configure(
+            ["run", "--no-conf", "--batch-deadline", "0"],
+            output=io.StringIO(),
+        )
+
+
 def test_metrics_port_invalid(tmp_path):
     conf = tmp_path / "fishnet.ini"
     conf.write_text("[Fishnet]\nKey = k\nMetricsPort = 70000\n")

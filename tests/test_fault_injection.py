@@ -87,11 +87,11 @@ async def test_client_recovers_from_service_death():
         await client.start()
         assert await wait_for(lambda: first in server.lichess.analyses)
 
-        # Kill the shared service under the running client. The next job
-        # fails and its batch is abandoned (reference semantics: the
-        # server's timeout would reassign it) — then the worker restarts
-        # its engine via the factory and the REPLACEMENT service serves
-        # subsequent work.
+        # Kill the shared service under the running client. The next
+        # job's position fails, is REQUEUED (bounded generations,
+        # sched/queue.py), the worker restarts its engine via the
+        # factory, and the REPLACEMENT service completes the batch —
+        # transient service death no longer loses acquired work.
         service.close()
         sacrificial = server.lichess.add_analysis_job(moves="d2d4", nodes=2000)
         for _ in range(100):
@@ -102,7 +102,12 @@ async def test_client_recovers_from_service_death():
         assert await wait_for(
             lambda: recovered in server.lichess.analyses, timeout=60
         )
-        assert sacrificial not in server.lichess.analyses  # abandoned, not lied about
+        assert await wait_for(
+            lambda: sacrificial in server.lichess.analyses, timeout=60
+        )
+        assert (
+            server.lichess.analysis_submission_counts[sacrificial] == 1
+        )  # recovered exactly once, not duplicated
         await client.stop()
     for svc in services:
         svc.close()

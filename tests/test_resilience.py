@@ -1,0 +1,435 @@
+"""Resilience subsystem (doc/resilience.md): the deterministic fault
+plane, the exactly-once batch ledger, requeue-with-cap and the deadline
+flush in the scheduler, the submit circuit breaker, and the
+degradation ladder — including bit-identical analysis output at every
+rung, forced through real fault plans."""
+
+import asyncio
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+from fake_server import VALID_KEY, FakeServer  # noqa: E402
+from test_client_e2e import make_client, wait_for  # noqa: E402
+
+from fishnet_tpu.client import Client
+from fishnet_tpu.engine.mock import MockEngineFactory
+from fishnet_tpu.net import api as api_mod
+from fishnet_tpu.resilience import accounting, faults
+from fishnet_tpu.resilience.accounting import BatchLedger, LedgerViolation
+from fishnet_tpu.resilience.faults import (
+    FaultCrash,
+    FaultInjected,
+    FaultPlan,
+    FaultPlanError,
+)
+from fishnet_tpu.resilience.supervisor import (
+    RUNGS,
+    CircuitBreaker,
+    RespawnBudgetExhausted,
+    ServiceSupervisor,
+)
+from fishnet_tpu.sched import queue as queue_mod
+from fishnet_tpu.utils.logger import Logger
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    yield
+    faults.clear()
+    accounting.clear()
+
+
+# -- fault plane ----------------------------------------------------------
+
+
+def test_fault_plan_parsing():
+    plan = FaultPlan.parse(
+        "seed=42; net.acquire:nth=2..3:error; net.submit:every=4:latency=0.5;"
+        "service.device_step:p=0.25:crash; engine.spawn:nth=1:hang=2"
+    )
+    assert plan.seed == 42
+    rules = plan.rules
+    assert rules["net.acquire"][0].lo == 2 and rules["net.acquire"][0].hi == 3
+    assert rules["net.submit"][0].trigger == "every"
+    assert rules["net.submit"][0].arg == 0.5
+    assert rules["service.device_step"][0].prob == 0.25
+    assert rules["engine.spawn"][0].action == "hang"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "nosuch.site:nth=1:error",
+        "net.acquire:nth=0:error",
+        "net.acquire:nth=3..2:error",
+        "net.acquire:wat=1:error",
+        "net.acquire:nth=1:explode",
+        "net.acquire:p=1.5:error",
+        "net.acquire:nth=1",
+        "seed=banana",
+        "net.acquire:nth=1:latency=-1",
+    ],
+)
+def test_fault_plan_rejects_bad_specs(bad):
+    with pytest.raises(FaultPlanError):
+        FaultPlan.parse(bad)
+
+
+def test_nth_trigger_is_deterministic():
+    faults.install("net.acquire:nth=3:error")
+    faults.fire("net.acquire")
+    faults.fire("net.acquire")
+    with pytest.raises(FaultInjected) as err:
+        faults.fire("net.acquire")
+    assert err.value.site == "net.acquire"
+    faults.fire("net.acquire")  # past the window: clean again
+    assert faults.current().counts()["net.acquire"] == 4
+
+
+def test_probability_trigger_is_seeded():
+    def decisions(seed):
+        plan = FaultPlan.parse(f"seed={seed};queue.schedule:p=0.5:error")
+        return [plan.poll("queue.schedule") is not None for _ in range(32)]
+
+    assert decisions(7) == decisions(7)  # same seed, same faults
+    assert decisions(7) != decisions(8)  # different seed, different faults
+
+
+def test_actions_latency_hang_crash():
+    faults.install(
+        "net.submit:nth=1:latency=0.05;net.submit:nth=2:hang=0.05;"
+        "net.submit:nth=3:crash"
+    )
+    t0 = time.monotonic()
+    faults.fire("net.submit")  # latency: sleeps, proceeds
+    assert time.monotonic() - t0 >= 0.05
+    with pytest.raises(FaultInjected):
+        faults.fire("net.submit")  # hang: sleeps then raises
+    with pytest.raises(FaultCrash):
+        faults.fire("net.submit")
+    assert issubclass(FaultCrash, FaultInjected)
+
+
+async def test_fire_async_in_event_loop():
+    faults.install("net.acquire:nth=1:error")
+    with pytest.raises(FaultInjected):
+        await faults.fire_async("net.acquire")
+
+
+def test_disabled_plane_is_inert():
+    assert not faults.enabled()
+    faults.fire("net.acquire")  # no plan: a no-op, never raises
+
+
+# -- batch ledger ---------------------------------------------------------
+
+
+def test_ledger_clean_lifecycle():
+    led = BatchLedger()
+    led.record_acquired("b1")
+    led.record_scheduled("b1")
+    led.record_stepped("b1")
+    led.record_requeued("b1", 1)
+    led.record_submitted("b1")
+    rep = led.assert_clean()
+    assert rep["submitted"] == 1 and rep["requeues"] == 1
+
+
+def test_ledger_flags_lost_and_duplicated():
+    led = BatchLedger()
+    led.record_acquired("lost1")
+    with pytest.raises(LedgerViolation):
+        led.assert_clean()
+    led.record_abandoned("lost1", "test")
+    led.assert_clean()
+
+    led.record_acquired("dup1")
+    led.record_submitted("dup1")
+    led.record_submitted("dup1")
+    with pytest.raises(LedgerViolation):
+        led.assert_clean()
+    assert led.report()["duplicated"] == ["dup1"]
+
+
+def test_ledger_reacquire_after_abandon_is_fresh_lifecycle():
+    led = BatchLedger()
+    led.record_acquired("b1")
+    led.record_abandoned("b1", "requeue_cap")
+    led.record_acquired("b1")  # server reassigned it to us again
+    led.record_submitted("b1")
+    rep = led.assert_clean()
+    assert rep["submitted"] == 1
+
+
+# -- circuit breaker ------------------------------------------------------
+
+
+def test_breaker_state_machine():
+    now = [0.0]
+    b = CircuitBreaker(
+        failure_threshold=2, cooldown_seconds=10.0, clock=lambda: now[0]
+    )
+    assert b.allow() and b.state == b.CLOSED
+    assert not b.record_failure()
+    assert b.record_failure()  # threshold reached: OPEN
+    assert b.state == b.OPEN and not b.allow()
+    assert b.remaining_cooldown() == 10.0
+    now[0] = 10.5
+    assert b.allow() and b.state == b.HALF_OPEN  # the probe
+    assert not b.allow()  # only one probe at a time
+    assert b.record_failure()  # failed probe: straight back to OPEN
+    assert b.state == b.OPEN
+    now[0] = 21.0
+    assert b.allow()
+    assert b.record_success()  # closed: caller drains parked work
+    assert b.state == b.CLOSED and b.allow()
+
+
+# -- supervisor ladder ----------------------------------------------------
+
+
+class _FakeService:
+    def __init__(self, rung):
+        self.psqt_path = rung or "xla"
+        self.failure_listener = None
+
+
+def test_supervisor_degrades_down_the_lattice():
+    built = []
+
+    def builder(rung):
+        svc = _FakeService(rung)
+        built.append(rung)
+        return svc
+
+    sup = ServiceSupervisor(
+        builder, degrade_after=2, healthy_seconds=3600, logger=Logger()
+    )
+    svc = sup.build()
+    assert built == [None]  # first build: auto-select
+    assert sup.rung == "xla"  # aligned to the realized path
+    assert svc.failure_listener == sup.note_failure
+    sup.build()  # death 1: respawn, same rung
+    assert built[-1] is None
+    sup.build()  # death 2: degrade
+    assert built[-1] == "host-material"
+    assert sup.rung == "host-material"
+    sup.build()
+    sup.build()  # already at the bottom: stays there
+    assert built[-1] == "host-material"
+    assert sup.respawns == 4
+
+
+def test_supervisor_respawn_budget():
+    sup = ServiceSupervisor(
+        lambda rung: _FakeService(rung), degrade_after=10,
+        max_respawns=2, respawn_window=3600, healthy_seconds=3600,
+    )
+    sup.build()
+    sup.build()
+    sup.build()
+    with pytest.raises(RespawnBudgetExhausted):
+        sup.build()
+
+
+def test_supervisor_start_rung_and_rungs_constant():
+    assert RUNGS == ("fused", "xla", "host-material")
+    sup = ServiceSupervisor(lambda rung: _FakeService(rung), start_rung="xla")
+    sup.build()
+    assert sup.rung == "xla"
+    with pytest.raises(ValueError):
+        ServiceSupervisor(lambda rung: None, start_rung="warp-drive")
+
+
+# -- client e2e under fault plans ----------------------------------------
+
+
+async def test_acquire_faults_retry_and_ledger_clean():
+    faults.install("net.acquire:nth=1..2:error")
+    led = accounting.install()
+    async with FakeServer() as server:
+        job = server.lichess.add_analysis_job(moves="e2e4")
+        client = make_client(server.endpoint, cores=1)
+        await client.start()
+        assert await wait_for(lambda: job in server.lichess.analyses)
+        await client.stop(abort_pending=False)
+    led.assert_clean()
+    assert led.record(job).terminal == "submitted"
+    assert faults.current().counts()["net.acquire"] >= 3
+
+
+async def test_spawn_fault_requeues_preserving_acquire_order():
+    base_requeued = queue_mod._REQUEUED.value()
+    faults.install("engine.spawn:nth=1:error")
+    led = accounting.install()
+    async with FakeServer() as server:
+        first = server.lichess.add_analysis_job(moves="e2e4")
+        second = server.lichess.add_analysis_job(moves="d2d4")
+        client = make_client(server.endpoint, cores=1)
+        await client.start()
+        assert await wait_for(
+            lambda: first in server.lichess.analyses
+            and second in server.lichess.analyses
+        )
+        await client.stop(abort_pending=False)
+        # The failed position was requeued at the FRONT: the first-
+        # acquired batch still finishes first, not starved behind the
+        # fresh batch (submission order == acquire order).
+        order = list(server.lichess.analyses)
+        assert order.index(first) < order.index(second)
+        assert server.lichess.analysis_submission_counts[first] == 1
+    assert queue_mod._REQUEUED.value() - base_requeued >= 1
+    rep = led.assert_clean()
+    assert rep["requeues"] >= 1
+
+
+async def test_requeue_generation_cap_abandons():
+    # A deterministically-failing position must not retry forever: after
+    # MAX_REQUEUE_GENERATIONS the batch is abandoned to the server's
+    # reassignment timeout (and accounted, not lost).
+    led = accounting.install()
+    async with FakeServer() as server:
+        doomed = server.lichess.add_analysis_job(moves="e2e4 e7e5 g1f3")
+        survivor = server.lichess.add_analysis_job(moves="d2d4")
+        factory = MockEngineFactory(fail_on="#3")
+        client = make_client(server.endpoint, cores=1, engine_factory=factory)
+        await client.start()
+        assert await wait_for(lambda: survivor in server.lichess.analyses)
+        assert await wait_for(
+            lambda: (led.record(doomed) or None) is not None
+            and led.record(doomed).terminal == "abandoned"
+        )
+        await client.stop(abort_pending=False)
+        assert doomed not in server.lichess.analyses
+        assert doomed not in server.lichess.aborted  # silent, like the reference
+    rec = led.record(doomed)
+    assert rec.requeues == queue_mod.MAX_REQUEUE_GENERATIONS
+    led.assert_clean()
+
+
+async def test_deadline_flushes_partial_analysis():
+    led = accounting.install()
+    async with FakeServer() as server:
+        job = server.lichess.add_analysis_job(moves="e2e4 e7e5")
+        factory = MockEngineFactory(hang_on="#1")  # ply 1 hangs forever
+        client = make_client(
+            server.endpoint, cores=2, engine_factory=factory,
+            batch_deadline=1.0,
+        )
+        await client.start()
+        assert await wait_for(lambda: job in server.lichess.analyses, timeout=20)
+        body = server.lichess.analyses[job]
+        await client.stop(abort_pending=True)
+    parts = body["analysis"]
+    assert len(parts) == 3
+    assert parts[1] == {"skipped": True}  # the hung ply, flushed as skipped
+    assert parts[0] is not None and parts[2] is not None
+    assert server.lichess.analysis_submission_counts[job] == 1
+    rec = led.record(job)
+    assert rec.flushed and rec.terminal == "submitted"
+    led.assert_clean()
+
+
+async def test_submit_failures_open_breaker_then_recover(monkeypatch):
+    monkeypatch.setenv(api_mod.BREAKER_THRESHOLD_ENV, "2")
+    monkeypatch.setenv(api_mod.BREAKER_COOLDOWN_ENV, "0.3")
+    base_retries = api_mod._SUBMIT_RETRIES.value()
+    led = accounting.install()
+    async with FakeServer() as server:
+        server.lichess.fail_submits = 2  # HTTP 500 on the first two finals
+        jobs = [
+            server.lichess.add_analysis_job(moves=m)
+            for m in ("e2e4", "d2d4", "g1f3")
+        ]
+        client = make_client(server.endpoint, cores=2)
+        await client.start()
+        assert await wait_for(
+            lambda: all(j in server.lichess.analyses for j in jobs),
+            timeout=30,
+        )
+        await client.stop(abort_pending=False)
+        counts = server.lichess.analysis_submission_counts
+        assert all(counts[j] == 1 for j in jobs)  # exactly once, each
+    assert api_mod._SUBMIT_RETRIES.value() - base_retries >= 1
+    led.assert_clean()
+    # Breaker closed again after recovery (gauge exports 0).
+    from fishnet_tpu.resilience.supervisor import _BREAKER_STATE
+
+    assert _BREAKER_STATE.labels(endpoint="submit").value == 0
+
+
+# -- degradation ladder: bit-identical output at every rung ---------------
+
+
+_LADDER_FENS = (
+    "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+    "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
+    "6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1",
+    "8/2k5/3p4/p2P1p2/P2P1P2/8/8/4K3 w - - 0 1",
+)
+
+
+async def _rung_results(svc):
+    svc.set_prefetch(0, adaptive=False)  # deterministic TT evolution
+    out = []
+    for fen in _LADDER_FENS:
+        r = await svc.search(fen, [], depth=1)
+        line = [l for l in r.lines if l.multipv == 1][-1]
+        out.append((fen, line.value, line.is_mate, r.best_move, r.nodes))
+    return out
+
+
+async def test_ladder_transitions_forced_by_fault_plans_are_bit_identical():
+    """Satellite 3: step fused -> xla -> host-material through REAL
+    device_step crash faults (supervisor + factory recovery path) and
+    pin bit-identical analysis output at every rung — degradation
+    trades efficiency, never correctness. Reuses the PR 2 parity
+    surface: the fused rung realizes the Pallas kernel in interpreter
+    mode on CPU, exactly like tests/test_ops.py."""
+    from fishnet_tpu.engine.tpu_engine import TpuNnueEngineFactory
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.protocol.types import EngineFlavor
+    from fishnet_tpu.search.service import SearchService
+
+    weights = NnueWeights.random(seed=21)  # the parity-suite net
+
+    def builder(rung):
+        return SearchService(
+            weights=weights, pool_slots=16, batch_capacity=64,
+            tt_bytes=8 << 20, backend="jax", psqt_path=rung,
+        )
+
+    sup = ServiceSupervisor(
+        builder, start_rung="fused", degrade_after=1, logger=Logger()
+    )
+    factory = TpuNnueEngineFactory(service_builder=sup.build)
+    results = {}
+    try:
+        for expected in ("fused", "xla", "host-material"):
+            engine = await factory.create(EngineFlavor.OFFICIAL)
+            assert engine.service.psqt_path == expected
+            results[expected] = await _rung_results(engine.service)
+            if expected != "host-material":
+                # Crash the device path on a FRESH position (a repeat
+                # would be answered from the TT without any dispatch);
+                # the next create() respawns one rung down
+                # (degrade_after=1).
+                faults.install("service.device_step:nth=1:crash")
+                with pytest.raises(Exception):
+                    await engine.service.search(
+                        "rnbqkb1r/pppppppp/5n2/8/3P4/8/PPP1PPPP/RNBQKBNR w KQkq - 1 2",
+                        [], depth=3,
+                    )
+                faults.clear()
+    finally:
+        factory.close()
+    assert results["fused"] == results["xla"] == results["host-material"], (
+        results
+    )
+    assert sup.rung == "host-material" and sup.respawns == 2

@@ -1,0 +1,38 @@
+"""`make soak-smoke`: the tier-1 resilience acceptance gate
+(doc/resilience.md). Runs the canned fault plan (acquire flaps, submit
+failures, one engine-spawn fault, one device_step crash) through the
+full soak harness and asserts the contract: every acquired batch
+submitted exactly once (ledger clean, server-side counts all 1), at
+least one fused->xla degradation and one pool respawn observed via the
+new counters, and /metrics exporting all four resilience families."""
+
+import pytest
+
+from fishnet_tpu.resilience import soak
+
+pytestmark = pytest.mark.anyio
+
+
+async def test_soak_canned_plan():
+    report = await soak.run_soak()
+    assert report["ok"], report
+    # Exactly-once: nothing lost, nothing duplicated, all submitted.
+    assert report["ledger"]["lost"] == []
+    assert report["ledger"]["duplicated"] == []
+    assert report["ledger"]["submitted"] == report["phase_a"]["jobs"]
+    assert all(
+        c == 1
+        for c in report["phase_a"]["server_submission_counts"].values()
+    )
+    # Recovery machinery observed via the new counters.
+    assert report["counters"]["requeued"] >= 1
+    assert report["counters"]["respawns"] >= 1
+    assert report["counters"]["degradations_fused_to_xla"] >= 1
+    assert report["phase_b"]["rung"] == "xla"
+    # The metric-family contract.
+    assert set(report["metric_families"]) == set(soak.REQUIRED_FAMILIES)
+
+
+def test_soak_cli_rejects_bad_plan(capsys):
+    assert soak.main(["--plan", "nosuch.site:nth=1:error"]) == 1
+    assert "SOAK FAILED" in capsys.readouterr().err

@@ -22,6 +22,69 @@ def test_backoff_cap():
         assert b.next() <= 0.2
 
 
+def test_backoff_full_jitter_distribution_bounds():
+    # AWS-style full jitter: attempt k draws uniformly from
+    # [0, min(cap, 0.1 * 2**k)) — the low bound is 0 (not 100 ms) and
+    # the envelope doubles per attempt until the cap.
+    b = RandomizedBackoff(max_backoff_seconds=30.0, jitter="full")
+    for attempt in range(24):
+        d = b.next()
+        assert 0.0 <= d <= min(30.0, 0.1 * 2.0 ** attempt), (attempt, d)
+    b.reset()
+    # Re-armed: the envelope starts over at 100 ms.
+    for _ in range(50):
+        assert b.next() <= 0.1
+        b.reset()
+
+
+def test_backoff_full_jitter_spreads_below_decorrelated_floor():
+    # The point of full jitter: draws BELOW the decorrelated 100 ms
+    # floor are possible (herd spreading). Statistically certain in
+    # 200 draws of uniform(0, 0.1].
+    b = RandomizedBackoff(max_backoff_seconds=30.0, jitter="full")
+    draws = []
+    for _ in range(200):
+        draws.append(b.next())
+        b.reset()
+    assert min(draws) < 0.1
+
+
+def test_backoff_reset_after_grace(monkeypatch):
+    import fishnet_tpu.utils.backoff as backoff_mod
+
+    now = [0.0]
+    monkeypatch.setattr(backoff_mod.time, "monotonic", lambda: now[0])
+    import random as _random
+
+    _random.seed(1234)  # deterministic draws: the outage state is fixed
+    b = RandomizedBackoff(max_backoff_seconds=30.0, reset_after=10.0)
+    for _ in range(30):  # a long outage: state grows toward the cap
+        b.next()
+    last = b._last
+    assert last > 0.2
+    # One success right after the outage must NOT instantly re-arm
+    # 100 ms retries: the state only decays one step per reset.
+    now[0] += 1.0
+    b.reset()
+    assert b._last == last / 2.0
+    b.reset()  # no new failure since; still inside the grace window
+    assert b._last in (last / 4.0, 0.0)  # 0.0 once decayed below the floor
+    # Healthy for longer than the grace period: full re-arm.
+    now[0] += 11.0
+    b.reset()
+    assert b._last == 0.0
+    assert 0.1 <= b.next() <= 0.4
+
+
+def test_backoff_rejects_bad_modes():
+    import pytest
+
+    with pytest.raises(ValueError):
+        RandomizedBackoff(jitter="sawtooth")
+    with pytest.raises(ValueError):
+        RandomizedBackoff(reset_after=-1.0)
+
+
 def test_nps_recorder_ewma():
     r = NpsRecorder(cores=2)
     assert r.nps == 800_000
