@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke coalesce-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
@@ -24,6 +24,16 @@ metrics-smoke:
 # on /metrics.
 soak-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_soak.py -q
+
+# Coalesced-dispatch contract (doc/wire-format.md "Segmented
+# dispatch"): segmented-vs-per-group bit parity on all three psqt_path
+# rungs, the deterministic width policy, and the smoke — a
+# low-occupancy mock workload run once coalesced and once with
+# FISHNET_NO_COALESCE=1 must produce identical analyses while the
+# coalesced run issues strictly fewer device dispatches than eval
+# steps.
+coalesce-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_coalesce.py -q
 
 # ASan+UBSan pool stress incl. the anchor full-provide guard case —
 # the non-tier-1 `slow` job.

@@ -567,6 +567,17 @@ def traffic_report(counters: dict, total_nodes: int) -> dict:
         "wire_material_mb_per_step": round(
             counters.get("wire_material_bytes", 0) / steps / 1e6, 3
         ),
+        # Dispatch coalescing: device dispatch calls per native pool
+        # step, and the average number of group microbatches fused per
+        # dispatch (eval_steps / dispatches; 1.0 = nothing coalesced).
+        "dispatches_per_step": round(
+            counters.get("dispatches", 0) / steps, 3
+        ),
+        "coalesce_width_avg": round(
+            counters.get("eval_steps", 0)
+            / max(1, counters.get("dispatches", 0)),
+            3,
+        ),
         # Fraction of shipped eval slots that went out as incremental
         # deltas (8 row-DMAs instead of ~64 on the device).
         "delta_coverage": round(
@@ -787,7 +798,41 @@ async def run_searches(service, jobs, nodes: int,
     return total, at_deadline, at_warm
 
 
-def main() -> None:
+def emit_summary(summary: dict, json_out: str) -> None:
+    """Emit the bench summary on both guaranteed channels. BENCH
+    r02-r05 tails were unparseable: the one stdout JSON line raced the
+    stderr progress stream in the capturing driver's merged view. Now
+    the summary is written WHOLE to ``json_out`` first (the robust
+    artifact a driver should prefer), then — after flushing stderr so
+    no progress line can interleave — printed as exactly one final
+    flush-terminated line on stdout."""
+    line = json.dumps(summary)
+    if json_out:
+        try:
+            with open(json_out, "w") as fp:
+                fp.write(line + "\n")
+            log(f"bench: summary written to {json_out}")
+        except OSError as err:
+            log(f"bench: could not write {json_out}: {err!r}")
+    sys.stderr.flush()
+    print(line, flush=True)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="bench.py",
+        description="fishnet-tpu headline benchmark (progress on "
+        "stderr; exactly one JSON summary line on stdout).",
+    )
+    parser.add_argument(
+        "--json-out", default="bench_summary.json",
+        help="also write the summary JSON whole to this path "
+        "(default: bench_summary.json; empty string disables)",
+    )
+    args = parser.parse_args(argv)
+
     from fishnet_tpu.nnue.weights import NnueWeights
     from fishnet_tpu.search.service import SearchService
 
@@ -1055,23 +1100,26 @@ def main() -> None:
     quality = bench_search_quality()
     log(f"bench: search quality done in {time.perf_counter() - t:.1f}s: {quality}")
 
-    print(
-        json.dumps(
-            {
-                "metric": "aggregate_search_nps",
-                "value": round(nps),
-                "unit": "nodes/s",
-                "vs_baseline": round(nps / REFERENCE_BASELINE_NPS, 4),
-                "psqt_path": service.psqt_path,
-                "transport": transport,
-                "device": device,
-                "host": host,
-                "az": az,
-                "frc": frc,
-                "traffic": traffic,
-                "search_quality": quality,
-            }
-        )
+    emit_summary(
+        {
+            "metric": "aggregate_search_nps",
+            "value": round(nps),
+            "unit": "nodes/s",
+            "vs_baseline": round(nps / REFERENCE_BASELINE_NPS, 4),
+            "psqt_path": service.psqt_path,
+            # Coalescing headline pair (median window): device dispatch
+            # calls per pool step and average fused width.
+            "dispatches_per_step": traffic.get("dispatches_per_step"),
+            "coalesce_width_avg": traffic.get("coalesce_width_avg"),
+            "transport": transport,
+            "device": device,
+            "host": host,
+            "az": az,
+            "frc": frc,
+            "traffic": traffic,
+            "search_quality": quality,
+        },
+        args.json_out,
     )
 
 

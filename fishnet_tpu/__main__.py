@@ -144,17 +144,25 @@ def build_search_service(opt: Opt, logger: Logger, psqt_path=None):
     evaluator = build_sharded_evaluator(opt, weights, logger)
 
     depth = opt.pipeline
+    dispatch_probe = None
     if depth is None:
         try:
             # Probe at the production microbatch size: overlap ratios are
             # shape-dependent (dispatch overhead vs compute time). When a
             # sharded evaluator is installed, probe THAT — the
             # single-device jit's overlap says nothing about the sharded
-            # computation serving will actually run.
-            depth = suggest_pipeline_depth(
+            # computation serving will actually run. The same probe run
+            # reports the fixed-vs-marginal dispatch cost that seeds the
+            # dispatch coalescer's width policy.
+            depth, dispatch_probe = suggest_pipeline_depth(
                 weights,
                 size=max(64, min(opt.resolved_microbatch(), 4096)),
                 eval_fn=evaluator,
+                return_probe=True,
+            )
+            logger.info(
+                f"Dispatch cost probe: fixed {dispatch_probe.fixed_ms} ms, "
+                f"marginal {dispatch_probe.marginal_ms_per_kslot} ms/kslot."
             )
         except Exception as err:  # noqa: BLE001 - probe is best-effort
             logger.debug(f"Pipeline probe failed ({err!r}); using depth 2.")
@@ -175,6 +183,7 @@ def build_search_service(opt: Opt, logger: Logger, psqt_path=None):
         evaluator=evaluator,
         driver_threads=opt.resolved_search_threads(),
         psqt_path=psqt_path,
+        dispatch_probe=dispatch_probe,
     )
 
 

@@ -374,12 +374,36 @@ def evaluate_packed_anchored(
     kernel in Pallas interpreter mode on non-TPU backends (the PR 2
     parity fixtures' venue).
     """
-    from fishnet_tpu.ops.ft_gather import decode_parent, ft_accumulate
-
     parent = parent.astype(jnp.int32)
     rows_per = jnp.where(_is_delta(parent), 1, 4)
     offsets = jnp.cumsum(rows_per) - rows_per  # exclusive prefix sum
     offsets = jnp.minimum(offsets, n_rows.astype(jnp.int32)[0])
+    return _packed_anchored_core(
+        params, packed, offsets, buckets, parent, material,
+        anchor_tab, psqt_tab, use_pallas, interpret,
+    )
+
+
+def _packed_anchored_core(
+    params: Params,
+    packed: jax.Array,
+    offsets: jax.Array,
+    buckets: jax.Array,
+    parent: jax.Array,
+    material: Optional[jax.Array],
+    anchor_tab: jax.Array,
+    psqt_tab: jax.Array,
+    use_pallas: Optional[bool],
+    interpret: bool,
+):
+    """Shared tail of the anchored packed entry points (single-group and
+    segmented): expand the row stream, run the fused/XLA accumulate with
+    table resolution, evaluate the head, and scatter anchor entries'
+    resolved accumulators (and PSQT twins) back to their table rows.
+    ``anchor_tab``/``psqt_tab`` are FLAT [A, 2, ...]; returns
+    ``(values, new_tab, new_psqt_tab)`` with the same flat shapes."""
+    from fishnet_tpu.ops.ft_gather import decode_parent, ft_accumulate
+
     dense = expand_packed(packed, offsets, parent)
     psqt = None
     if material is None:
@@ -429,6 +453,77 @@ def evaluate_packed_anchored(
 #: the returned tables — the input buffers are dead after the call).
 evaluate_packed_anchored_jit = jax.jit(
     evaluate_packed_anchored,
+    donate_argnums=(5, 7),
+    static_argnames=("use_pallas", "interpret"),
+)
+
+
+def evaluate_packed_anchored_segmented(
+    params: Params,
+    packed: jax.Array,
+    buckets: jax.Array,
+    parent: jax.Array,
+    material: Optional[jax.Array],
+    anchor_tabs: jax.Array,
+    seg_rows: jax.Array,
+    psqt_tabs: jax.Array,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+):
+    """K groups' packed row streams fused into ONE device dispatch — the
+    coalesced-dispatch wire (doc/wire-format.md "Segmented dispatch").
+
+    Layout: ``packed`` [K*tier, 2, 8] is K per-group streams, each
+    padded to the common row tier ``tier`` with its OWN sentinel block
+    at its emitted-row count; ``buckets``/``parent`` (and ``material``
+    on the host-material rung) are [K*size], each segment padded to the
+    common entry bucket ``size`` with sentinel entries (parent -1,
+    bucket 0); ``seg_rows`` int32 [K] carries each segment's emitted
+    row count (the per-segment twin of the single-group ``n_rows``
+    scalar). ``anchor_tabs`` [K, A, 2, L1] / ``psqt_tabs`` [K, A, 2, 8]
+    are the dispatching groups' tables STACKED on a leading group axis,
+    donated and returned exactly like the per-group call's tables.
+
+    Parent codes arrive segment-local exactly as each group's pool
+    emitted them; they are rebased on device
+    (ops/ft_gather.recode_segment_parents) so in-batch refs and anchor
+    table rows stay inside their segment — anchors never cross a
+    segment boundary. The result is bit-identical, segment by segment,
+    to K separate ``evaluate_packed_anchored`` calls on the same
+    streams and tables (the tier-1 parity suite pins this across all
+    three psqt_path rungs).
+
+    Returns ``(values [K*size], new_anchor_tabs, new_psqt_tabs)``;
+    segment k's real entries are ``values[k*size : k*size + n_k]``.
+    """
+    from fishnet_tpu.ops.ft_gather import (
+        derive_segment_offsets,
+        recode_segment_parents,
+    )
+
+    k_segs = anchor_tabs.shape[0]
+    anchor_rows = anchor_tabs.shape[1]
+    size = buckets.shape[0] // k_segs
+    tier = packed.shape[0] // k_segs
+    parent = parent.astype(jnp.int32).reshape(k_segs, size)
+    offsets = derive_segment_offsets(parent, seg_rows, tier)
+    gparent = recode_segment_parents(parent, anchor_rows)
+    flat_tab = anchor_tabs.reshape(k_segs * anchor_rows, 2, -1)
+    flat_ptab = psqt_tabs.reshape(k_segs * anchor_rows, 2, -1)
+    values, new_tab, new_ptab = _packed_anchored_core(
+        params, packed, offsets, buckets, gparent, material,
+        flat_tab, flat_ptab, use_pallas, interpret,
+    )
+    return (
+        values,
+        new_tab.reshape(anchor_tabs.shape),
+        new_ptab.reshape(psqt_tabs.shape),
+    )
+
+
+#: Stacked tables donated, like the per-group jit.
+evaluate_packed_anchored_segmented_jit = jax.jit(
+    evaluate_packed_anchored_segmented,
     donate_argnums=(5, 7),
     static_argnames=("use_pallas", "interpret"),
 )

@@ -50,7 +50,11 @@ from jax.experimental.pallas import tpu as pltpu
 from fishnet_tpu.nnue.spec import DELTA_SLOTS as _DELTA_SLOTS
 from fishnet_tpu.utils.tracing import is_concrete
 
-__all__ = ["ft_accumulate"]
+__all__ = [
+    "ft_accumulate",
+    "derive_segment_offsets",
+    "recode_segment_parents",
+]
 
 #: Accumulator poison for persistent anchor codes evaluated WITHOUT an
 #: anchor table.  Under tracing the misuse cannot raise (the values are
@@ -126,6 +130,57 @@ def decode_parent(parent: jax.Array):
     ).astype(bool)
     aid = jnp.where(stores, v >> 2, 0)
     return in_batch, persistent, stores, ref, swap, aid
+
+
+def derive_segment_offsets(parent: jax.Array, seg_rows: jax.Array,
+                           tier: int) -> jax.Array:
+    """Row offsets for a SEGMENTED (coalesced multi-group) dispatch.
+
+    ``parent`` int32 [K, size] holds each segment's wire parent codes;
+    ``seg_rows`` int32 [K] each segment's emitted row count; ``tier``
+    is the common per-segment row tier of the concatenated [K*tier]
+    stream. Per segment the offsets are the usual exclusive cumsum
+    (4 rows per full entry, 1 per delta), but each segment's padding
+    clamps into ITS OWN sentinel block at ``seg_rows[k]`` and the whole
+    segment shifts by ``k*tier`` — offsets never cross a segment
+    boundary, the same invariant the sharded repack enforces per shard
+    (search/service.py _dispatch_sharded_packed). Returns flat int32
+    [K*size] offsets into the concatenated row stream."""
+    parent = parent.astype(jnp.int32)
+    k_segs = parent.shape[0]
+    in_batch, persistent, _, _, _, _ = decode_parent(parent.reshape(-1))
+    is_delta = (in_batch | persistent).reshape(parent.shape)
+    rows_per = jnp.where(is_delta, 1, 4)
+    local = jnp.cumsum(rows_per, axis=1) - rows_per  # exclusive per segment
+    local = jnp.minimum(local, seg_rows.astype(jnp.int32)[:, None])
+    base = (jnp.arange(k_segs, dtype=jnp.int32) * jnp.int32(tier))[:, None]
+    return (local + base).reshape(-1)
+
+
+def recode_segment_parents(parent: jax.Array, anchor_rows: int) -> jax.Array:
+    """Rebase segment-local wire parent codes into the fused frame of a
+    segmented dispatch. ``parent`` int32 [K, size]; ``anchor_rows`` is
+    one group's anchor-table row count A (the stacked [K, A, ...]
+    tables flatten to [K*A, ...]).
+
+    In-batch refs (code ``ref << 1 | swap``) shift by the segment's
+    entry base ``k*size``; persistent anchor codes (``-(2 + v)``,
+    ``v = (row << 2) | bits``) shift their table row by the segment's
+    table base ``k*A``; plain fulls (-1) pass through. Because the pool
+    guarantees every group batch STARTS with an anchor entry (full or
+    persistent), the fused kernel's running in-VMEM anchor resets at
+    each segment's first entry and never leaks across a segment
+    boundary — the recoded stream satisfies exactly the contract the
+    single-group kernel (and its bit-identical XLA twin,
+    _xla_resolve_parents) already enforce, so no new kernel mode is
+    needed. Returns flat int32 [K*size]."""
+    parent = parent.astype(jnp.int32)
+    k_segs, size = parent.shape
+    entry_base = (jnp.arange(k_segs, dtype=jnp.int32) * size)[:, None]
+    tab_base = (jnp.arange(k_segs, dtype=jnp.int32) * anchor_rows)[:, None]
+    out = jnp.where(parent >= 0, parent + (entry_base << 1), parent)
+    out = jnp.where(parent <= -2, parent - (tab_base << 2), out)
+    return out.reshape(-1)
 
 
 def _xla_resolve_parents(

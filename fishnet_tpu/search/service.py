@@ -133,9 +133,72 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
     lib._pool_bound = True
 
 
+@dataclass(frozen=True)
+class DispatchProbe:
+    """Measured cost decomposition of one blocking device dispatch:
+    ``fixed_ms`` is the payload-independent term (transport round trip,
+    dispatch bookkeeping), ``marginal_ms_per_kslot`` the incremental
+    cost of shipping and evaluating 1024 more entries. ``small``/``big``
+    record the probed batch sizes. BENCH_r05's transport tier is the
+    motivating shape: rtt_ms_256 ~104 vs rtt_ms_16384 ~399 — 64x the
+    rows for 3.8x the time, i.e. a ~95 ms fixed term that dominates
+    lightly-loaded dispatches."""
+
+    fixed_ms: float
+    marginal_ms_per_kslot: float
+    small: int = 0
+    big: int = 0
+
+
+def fit_dispatch_cost(t_small_s: float, t_big_s: float,
+                      small_slots: int, big_slots: int) -> DispatchProbe:
+    """Fit the two-point dispatch-cost model from two blocking-eval
+    timings (seconds). Pure and deterministic — the unit tests feed it
+    recorded probe numbers."""
+    per_slot_ms = (
+        max(0.0, t_big_s - t_small_s) * 1e3
+        / max(1, big_slots - small_slots)
+    )
+    fixed_ms = max(0.0, t_small_s * 1e3 - per_slot_ms * small_slots)
+    return DispatchProbe(
+        fixed_ms=round(fixed_ms, 3),
+        marginal_ms_per_kslot=round(per_slot_ms * 1024, 4),
+        small=int(small_slots),
+        big=int(big_slots),
+    )
+
+
+def choose_coalesce_width(fixed_ms: float, marginal_ms_per_kslot: float,
+                          slots_per_step: float, n_groups: int,
+                          cap: int = 8) -> int:
+    """How many ready pipeline-group microbatches to fuse into one
+    segmented device dispatch. Deterministic (probe numbers + observed
+    occupancy in, width out — the unit-test contract).
+
+    Fusing w microbatches turns ``w*(fixed + payload)`` into
+    ``fixed + w*payload``: each extra segment saves one fixed term and
+    adds only its payload. The win per segment collapses once one
+    segment's payload already rivals the fixed cost (and past that,
+    fusing only serializes batches that could have pipelined), so the
+    policy fuses until ``payload * w ~ fixed``:
+    ``w = fixed // payload + 1``, clamped to [1, min(n_groups, cap)]
+    and floored to a power of two — segment count is a compile shape,
+    and the power-of-two lattice bounds the number of distinct
+    segmented programs a serving process can ever compile."""
+    limit = max(1, min(int(n_groups), int(cap)))
+    if limit == 1 or fixed_ms <= 0:
+        return 1
+    payload_ms = (
+        max(0.0, marginal_ms_per_kslot) * max(1.0, slots_per_step) / 1024.0
+    )
+    w = limit if payload_ms <= 0 else int(fixed_ms / payload_ms) + 1
+    w = max(1, min(limit, w))
+    return 1 << (w.bit_length() - 1)  # floor to a power of two
+
+
 def suggest_pipeline_depth(weights: "NnueWeights", size: int = 1024,
                            rounds: int = 4, device_params=None,
-                           eval_fn=None) -> int:
+                           eval_fn=None, return_probe: bool = False):
     """Probe whether concurrent device dispatches overlap, and suggest a
     pipeline depth for SearchService.
 
@@ -144,11 +207,17 @@ def suggest_pipeline_depth(weights: "NnueWeights", size: int = 1024,
     TPUs dispatch is asynchronous and 2-4 batches overlap host, PCIe and
     device time. The probe times `rounds` evals run back-to-back
     (blocking each) against the same evals dispatched together, and
-    returns 4/2/1 as the overlap ratio falls."""
+    returns 4/2/1 as the overlap ratio falls.
+
+    ``return_probe=True`` additionally times a SMALL batch through the
+    same evaluator and returns ``(depth, DispatchProbe)`` — the
+    fixed-vs-marginal dispatch-cost decomposition that drives the
+    dispatch coalescer's width policy (choose_coalesce_width)."""
     import time
 
     from fishnet_tpu.nnue import spec
 
+    mult = 1
     if eval_fn is None:
         import jax
 
@@ -164,15 +233,18 @@ def suggest_pipeline_depth(weights: "NnueWeights", size: int = 1024,
         # overlap of the single-device jit says nothing about a sharded
         # computation's.
         params = device_params
-        size = _round_up(size, max(1, int(getattr(eval_fn, "size_multiple", 1))))
+        mult = max(1, int(getattr(eval_fn, "size_multiple", 1)))
+        size = _round_up(size, mult)
     feats = np.full((size, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.uint16)
     buckets = np.zeros((size,), np.int32)
     np.asarray(eval_fn(params, feats, buckets))  # compile + warm
 
-    t0 = time.perf_counter()
+    big_times = []
     for _ in range(rounds):
+        t0 = time.perf_counter()
         np.asarray(eval_fn(params, feats, buckets))
-    sequential = time.perf_counter() - t0
+        big_times.append(time.perf_counter() - t0)
+    sequential = sum(big_times)
 
     t0 = time.perf_counter()
     arrs = [eval_fn(params, feats, buckets) for _ in range(rounds)]
@@ -182,10 +254,31 @@ def suggest_pipeline_depth(weights: "NnueWeights", size: int = 1024,
 
     ratio = sequential / max(pipelined, 1e-9)
     if ratio >= 2.5:
-        return 4
-    if ratio >= 1.6:
-        return 2
-    return 1
+        depth = 4
+    elif ratio >= 1.6:
+        depth = 2
+    else:
+        depth = 1
+    if not return_probe:
+        return depth
+
+    small = _round_up(max(32, size // 16), mult)
+    feats_s = np.full(
+        (small, 2, spec.MAX_ACTIVE_FEATURES), spec.NUM_FEATURES, np.uint16
+    )
+    buckets_s = np.zeros((small,), np.int32)
+    np.asarray(eval_fn(params, feats_s, buckets_s))  # compile + warm
+    small_times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        np.asarray(eval_fn(params, feats_s, buckets_s))
+        small_times.append(time.perf_counter() - t0)
+    probe = fit_dispatch_cost(
+        sorted(small_times)[len(small_times) // 2],
+        sorted(big_times)[len(big_times) // 2],
+        small, size,
+    )
+    return depth, probe
 
 
 def _round_up(n: int, multiple: int) -> int:
@@ -226,6 +319,12 @@ _COUNTER_METRICS = {
                       "anchors."),
     "eval_steps": ("fishnet_service_eval_steps_total", "counter",
                    "Device microbatches dispatched by the service."),
+    "dispatches": ("fishnet_dispatches_total", "counter",
+                   "Device dispatch calls actually issued — a fused "
+                   "segmented dispatch counts ONCE for all its groups, "
+                   "so dispatches < eval_steps measures coalescing."),
+    "fused_dispatches": ("fishnet_coalesced_dispatches_total", "counter",
+                         "Dispatches that fused >= 2 group microbatches."),
     "bucket_slots": ("fishnet_service_bucket_slots_total", "counter",
                      "Slots actually transferred (size-bucketed)."),
     "wire_feature_bytes": ("fishnet_service_wire_feature_bytes_total",
@@ -250,7 +349,8 @@ def _register_service_collector(svc: "SearchService") -> int:
         if service is None or service._pool is None:
             return None
         fams = []
-        for key, value in service.counters().items():
+        counters = service.counters()
+        for key, value in counters.items():
             spec_ = _COUNTER_METRICS.get(key)
             if spec_ is None:
                 continue
@@ -260,6 +360,17 @@ def _register_service_collector(svc: "SearchService") -> int:
                 else _telemetry.counter_family
             )
             fams.append(maker(name, help_, value))
+        # The dispatches counter's canonical pairing (doc/observability
+        # .md): fishnet_eval_steps_total is the per-group-microbatch
+        # series fishnet_dispatches_total divides against (alias of the
+        # legacy fishnet_service_eval_steps_total name).
+        fams.append(_telemetry.counter_family(
+            "fishnet_eval_steps_total",
+            "Group eval microbatches evaluated (alias of "
+            "fishnet_service_eval_steps_total; pair with "
+            "fishnet_dispatches_total for the coalesce ratio).",
+            counters.get("eval_steps", 0),
+        ))
         with service._lock:
             pending = sum(len(p) for p in service._pending)
             queued = sum(len(s) for s in service._submissions)
@@ -292,6 +403,238 @@ _LISTENER_ERRORS = _telemetry.REGISTRY.counter(
     "teardown (swallowed so the original crash stays visible).",
 )
 
+#: Microbatches fused per device dispatch (1 = an uncoalesced solo
+#: dispatch). Observed once per dispatch — cheap per-thread cells, so
+#: it stays always-on like the net/api counters.
+_COALESCE_WIDTH = _telemetry.REGISTRY.histogram(
+    "fishnet_dispatch_coalesce_width",
+    "Pipeline-group microbatches fused into one device dispatch.",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16),
+)
+_COALESCE_ERRORS = _telemetry.REGISTRY.counter(
+    "fishnet_coalesce_flush_errors_total",
+    "Coalesced-dispatch flushes that raised; the error is re-raised on "
+    "every owning driver thread at resolve time (R5: counted, not "
+    "swallowed).",
+)
+
+
+class _FusedValues:
+    """One fused dispatch's [K*size] value array, materialized to host
+    ONCE — a single device->host transfer shared by every segment
+    owner, instead of K per-slice fetches that would hand back K round
+    trips on the high-latency links coalescing exists to spare."""
+
+    __slots__ = ("_arr", "_np", "_lock")
+
+    def __init__(self, arr) -> None:
+        self._arr = arr
+        self._np = None
+        self._lock = threading.Lock()
+
+    def materialize(self) -> np.ndarray:
+        with self._lock:
+            if self._np is None:
+                self._np = np.asarray(self._arr)
+                self._arr = None
+            return self._np
+
+
+class _CoalesceTicket:
+    """One group's ready microbatch, parked in the coalescer until it
+    rides a (possibly fused) device dispatch. ``done`` is set by the
+    flushing thread after ``values``/``acct`` (or ``error``) are
+    assigned — the Event provides the cross-thread ordering. After a
+    FUSED dispatch ``values`` is a ``_FusedValues`` holder and
+    ``start``/``seg_size`` locate this segment's slice."""
+
+    __slots__ = (
+        "group", "n", "rows", "values", "start", "seg_size", "acct",
+        "error", "done",
+    )
+
+    def __init__(self, group: int, n: int, rows: int) -> None:
+        self.group = group
+        self.n = n
+        self.rows = rows
+        self.values = None
+        self.start = 0
+        self.seg_size = 0
+        self.acct = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+
+class _DispatchCoalescer:
+    """Fuses ready pipeline-group microbatches into segmented device
+    dispatches to amortize the FIXED per-dispatch transport cost
+    (DispatchProbe) across groups.
+
+    Protocol: driver threads ``submit()`` each stepped group's
+    microbatch and get a ticket back immediately (no waiting on the hot
+    path). A flush — one device dispatch covering every parked ticket —
+    happens when the parked count reaches the policy width, or when an
+    owner ``demand()``s a ticket that has not been dispatched yet (the
+    next loop iteration's resolve). That makes coalescing latency-free:
+    work is never delayed past the moment its result is actually
+    needed, and at width 1 the behavior degenerates to today's
+    dispatch-per-group loop.
+
+    The width adapts: ``submit`` keeps an EMA of real entries per
+    microbatch and ``choose_coalesce_width`` recomputes the width from
+    the startup DispatchProbe — low occupancy (where the fixed cost
+    dominates) fuses wide, full batches dispatch solo. With several
+    driver threads, ``demand`` lingers a bounded sub-RTT moment
+    (fixed_ms/16, capped at MAX_LINGER_S) so sibling threads' ready
+    microbatches join the dispatch instead of each thread flushing its
+    lone group solo.
+    ``FISHNET_COALESCE_WIDTH`` pins the width; ``FISHNET_NO_COALESCE=1``
+    bypasses the coalescer entirely (SearchService never builds one).
+    """
+
+    #: Never fuse more groups than this, whatever the probe says: the
+    #: segment count is a compile shape, and the stacked-table copies
+    #: scale with it.
+    MAX_WIDTH = 8
+
+    #: Upper bound on the cross-thread linger (seconds): with T driver
+    #: threads owning one ready group each, a thread demanding its own
+    #: ticket immediately after submitting it would always flush solo —
+    #: so demand() waits this long (or fixed_ms/16, whichever is less)
+    #: for sibling threads' microbatches to join the dispatch. Noise
+    #: against the fixed cost it saves, and zero when only one driver
+    #: thread exists (its own groups are already all parked).
+    MAX_LINGER_S = 0.005
+
+    def __init__(self, svc: "SearchService",
+                 pinned_width: Optional[int] = None) -> None:
+        self._svc = svc
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: List[_CoalesceTicket] = []
+        self._pinned = pinned_width
+        self._probe: Optional[DispatchProbe] = None
+        self._occ_ema: Optional[float] = None
+        self.width = pinned_width if pinned_width is not None else 1
+        self._linger_s = (
+            self.MAX_LINGER_S
+            if pinned_width is not None and pinned_width > 1 else 0.0
+        )
+        if svc.driver_threads <= 1:
+            self._linger_s = 0.0
+        # Lock-guarded dispatch accounting (one increment per DISPATCH,
+        # ~Hz — not a hot path; counters() reads them for telemetry).
+        self.dispatches = 0
+        self.fused_dispatches = 0
+        self.coalesced_steps = 0
+
+    def set_probe(self, probe: DispatchProbe) -> None:
+        with self._lock:
+            self._probe = probe
+            self._recompute_width()
+
+    def _recompute_width(self) -> None:
+        # Caller holds self._lock.
+        if self._pinned is not None:
+            self.width = max(1, min(self._pinned, self.MAX_WIDTH))
+            return
+        if self._probe is None:
+            return  # width stays 1 until the warmup probe lands
+        slots = self._occ_ema if self._occ_ema is not None else 1.0
+        self.width = choose_coalesce_width(
+            self._probe.fixed_ms, self._probe.marginal_ms_per_kslot,
+            slots, self._svc._n_groups, cap=self.MAX_WIDTH,
+        )
+        if self._svc.driver_threads > 1 and self.width > 1:
+            self._linger_s = min(
+                self.MAX_LINGER_S, self._probe.fixed_ms / 1e3 / 16
+            )
+        else:
+            self._linger_s = 0.0
+
+    def submit(self, group: int, n: int, rows: int) -> _CoalesceTicket:
+        """Park a stepped group's microbatch; returns its ticket. May
+        flush (dispatch) on this thread if the policy width is reached."""
+        ticket = _CoalesceTicket(group, n, rows)
+        flush = None
+        with self._lock:
+            ema = self._occ_ema
+            self._occ_ema = n if ema is None else 0.8 * ema + 0.2 * n
+            self._recompute_width()
+            self._pending.append(ticket)
+            if len(self._pending) >= self.width:
+                flush, self._pending = self._pending, []
+            self._cond.notify_all()  # wake lingering demand()s
+        if flush:
+            self._flush(flush)
+        return ticket
+
+    def demand(self, ticket: _CoalesceTicket):
+        """Block until ``ticket`` has been dispatched; returns its value
+        slice. Called by the owning driver when it needs the result —
+        after a bounded linger for sibling threads' ready microbatches,
+        flushes everything parked (the ticket included, unless another
+        thread's flush already claimed it)."""
+        if not ticket.done.is_set():
+            if self._linger_s > 0.0:
+                deadline = time.monotonic() + self._linger_s
+                with self._cond:
+                    while (
+                        ticket in self._pending
+                        and len(self._pending) < self.width
+                    ):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
+            with self._lock:
+                flush, self._pending = self._pending, []
+            if flush:
+                self._flush(flush)
+        ticket.done.wait()
+        if ticket.error is not None:
+            raise NativeCoreError(
+                f"coalesced dispatch failed: {ticket.error!r}"
+            ) from ticket.error
+        values = ticket.values
+        if isinstance(values, _FusedValues):
+            whole = values.materialize()
+            return whole[ticket.start : ticket.start + ticket.seg_size]
+        return values
+
+    def _flush(self, tickets: List[_CoalesceTicket]) -> None:
+        svc = self._svc
+        tel = _telemetry.enabled()
+        t0 = time.monotonic() if tel else 0.0
+        try:
+            if len(tickets) == 1:
+                tk = tickets[0]
+                tk.values, tk.acct = svc._dispatch_eval(tk.group, tk.n, tk.rows)
+            else:
+                svc._dispatch_segmented(tickets)
+        except BaseException as err:  # noqa: BLE001 - delivered to every owner
+            _COALESCE_ERRORS.inc()
+            for tk in tickets:
+                tk.error = err
+                tk.done.set()
+            if not isinstance(err, Exception):
+                raise  # KeyboardInterrupt and friends still unwind here
+            return
+        with self._lock:
+            self.dispatches += 1
+            if len(tickets) > 1:
+                self.fused_dispatches += 1
+                self.coalesced_steps += len(tickets)
+        _COALESCE_WIDTH.observe(len(tickets))
+        for tk in tickets:
+            tk.done.set()
+        if tel and len(tickets) > 1:
+            _SPANS.record(
+                "coalesce", t0, width=len(tickets),
+                groups=[tk.group for tk in tickets],
+                n=sum(tk.n for tk in tickets),
+            )
+
 
 #: Must cover the native core's largest single eval block
 #: (cpp/src/search.h:32 EVAL_BLOCK_MAX): emit_block is all-or-nothing, so
@@ -316,6 +659,7 @@ class SearchService:
         evaluator=None,
         driver_threads: int = 1,
         psqt_path: Optional[str] = None,
+        dispatch_probe: Optional[DispatchProbe] = None,
     ) -> None:
         """``evaluator``: optional callable ``(params, indices, buckets) ->
         int32 [B]`` replacing the built-in single-device
@@ -333,7 +677,12 @@ class SearchService:
         ``"host-material"`` restores the legacy host-material wire.
         All rungs produce bit-identical analysis output; only the
         builtin single-device evaluator honors the request (sharded
-        meshes always run host-material)."""
+        meshes always run host-material).
+
+        ``dispatch_probe``: a pre-measured DispatchProbe (e.g. from
+        ``suggest_pipeline_depth(..., return_probe=True)``) seeding the
+        dispatch coalescer's width policy; None = the service probes
+        its own eval path during warmup."""
         if psqt_path not in (None, "fused", "xla", "host-material"):
             raise ValueError(f"unknown psqt_path request: {psqt_path!r}")
         self._lib = load()
@@ -462,9 +811,19 @@ class SearchService:
                 while s < cap:
                     sizes.add(s)
                     s *= 2
-            sizes.add(cap)
             sizes.add(self._group_capacity)  # groups fill to this bucket
-            self._eval_sizes = sorted({min(s, cap) for s in sizes})
+            # Clamp every bucket to the GROUP capacity: fc_pool_step is
+            # called with _group_capacity, so a group microbatch can
+            # never exceed it — buckets past it were dead weight (one
+            # wasted XLA compile each) AND they starved the largest
+            # REACHABLE bucket of its finer row tiers (_row_tiers keys
+            # on the last bucket), which is why BENCH r02-r05 reported a
+            # constant wire_mb_per_step across windows with very
+            # different occupancy: every step shipped the one maximal
+            # all-full tier of the group bucket regardless of content.
+            self._eval_sizes = sorted(
+                {min(s, self._group_capacity) for s in sizes}
+            )
             self._shard_align = 0
         # COMPACT WIRE: the pool emits a packed uint16 row stream (full
         # entry = 4 rows of [2][8], delta entry = 1 row) — deltas ship
@@ -576,6 +935,44 @@ class SearchService:
             self._eval_fn = functools.partial(
                 self._eval_fn, use_pallas=up, interpret=interp
             )
+        # DISPATCH COALESCER: when several pipeline groups have
+        # microbatches ready, fuse them into ONE segmented device
+        # dispatch (evaluate_packed_anchored_segmented) instead of
+        # n_groups separate ones — the fixed per-dispatch transport
+        # cost (DispatchProbe; ~95 ms on the measured tunnel) is paid
+        # once per fused batch instead of once per group, which is the
+        # whole bill at low occupancy. Builtin packed wire only: the
+        # sharded mesh and external evaluators keep per-group dispatch.
+        # FISHNET_NO_COALESCE=1 is the escape hatch (no coalescer is
+        # built at all: byte-for-byte the old dispatch loop);
+        # FISHNET_COALESCE_WIDTH pins the width instead of the policy.
+        self._coalescer = None
+        self._segmented_fn = None
+        self.dispatch_probe = dispatch_probe
+        if (
+            self._packed_wire and self._n_groups > 1
+            and os.environ.get("FISHNET_NO_COALESCE", "0") != "1"
+        ):
+            import functools
+
+            from fishnet_tpu.nnue.jax_eval import (
+                evaluate_packed_anchored_segmented_jit,
+            )
+
+            seg_fn = evaluate_packed_anchored_segmented_jit
+            if self._eval_force is not None:
+                up, interp = self._eval_force
+                seg_fn = functools.partial(
+                    seg_fn, use_pallas=up, interpret=interp
+                )
+            self._segmented_fn = seg_fn
+            pinned = None
+            pin_env = os.environ.get("FISHNET_COALESCE_WIDTH")
+            if pin_env:
+                pinned = max(1, min(int(pin_env), self._n_groups))
+            self._coalescer = _DispatchCoalescer(self, pinned_width=pinned)
+            if dispatch_probe is not None:
+                self._coalescer.set_probe(dispatch_probe)
         self._packed_buf = np.empty((k, 4 * cap + 4, 2, 8), dtype=np.uint16)
         self._offset_buf = np.empty((k, cap), dtype=np.int32)
         self._bucket_buf = np.empty((k, cap), dtype=np.int32)
@@ -780,7 +1177,93 @@ class SearchService:
                                 self._params, feats, bucks, parents, material
                             )
                         )
+            if self._coalescer is not None and not self._stopping:
+                # Seed the width policy: measure this eval path's
+                # fixed-vs-marginal dispatch cost (unless the caller
+                # supplied a probe or pinned the width), then compile
+                # the segmented shapes the chosen width will dispatch —
+                # all on the already-compiled solo buckets, so the probe
+                # itself costs a handful of round trips, no compiles.
+                if (
+                    self.dispatch_probe is None
+                    and self._coalescer._pinned is None
+                ):
+                    self.dispatch_probe = self._probe_dispatch_cost()
+                    self._coalescer.set_probe(self.dispatch_probe)
+                self._warm_segmented()
             self._warmed = True
+
+    def _probe_dispatch_cost(self, rounds: int = 3) -> DispatchProbe:
+        """Time blocking solo dispatches at the smallest and largest
+        compiled buckets and fit the two-point cost model. Single-bucket
+        services degenerate to marginal 0 (= assume fixed-dominated)."""
+        s_small, s_big = self._eval_sizes[0], self._eval_sizes[-1]
+
+        def timed(size: int) -> float:
+            tier = self._row_tiers(size)[0]
+            packed = np.full((tier, 2, 8), spec.NUM_FEATURES, np.uint16)
+            bucks = np.zeros((size,), np.int32)
+            parents = np.full((size,), -1, np.int32)
+            material = (
+                None if self._device_psqt else np.zeros((size,), np.int32)
+            )
+            ts = []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                values, self._anchor_tabs[0], self._psqt_tabs[0] = (
+                    self._eval_fn(
+                        self._params, packed, bucks, parents, material,
+                        self._anchor_tabs[0], np.array([0], np.int32),
+                        self._psqt_tabs[0],
+                    )
+                )
+                np.asarray(values)
+                ts.append(time.perf_counter() - t0)
+            return sorted(ts)[len(ts) // 2]
+
+        return fit_dispatch_cost(timed(s_small), timed(s_big), s_small, s_big)
+
+    def _warm_segmented(self) -> None:
+        """Compile the segmented shapes the CURRENT policy width will
+        dispatch: the FIRST row tier of the smallest and largest
+        buckets — the shapes the low-occupancy regime (where coalescing
+        actually fires) ships. The width adapts with live occupancy and
+        fuller tiers exist, so other segmented programs can still
+        compile lazily mid-traffic — the common case is covered here
+        without multiplying warmup compiles."""
+        width = self._coalescer.width
+        if width <= 1 or self._segmented_fn is None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        rows_a = self._anchor_tabs[0].shape[0]
+        for size in sorted({self._eval_sizes[0], self._eval_sizes[-1]}):
+            for tier in self._row_tiers(size)[:1]:
+                if self._stopping:
+                    return
+                packed = np.full(
+                    (width * tier, 2, 8), spec.NUM_FEATURES, np.uint16
+                )
+                bucks = np.zeros((width * size,), np.int32)
+                parents = np.full((width * size,), -1, np.int32)
+                material = (
+                    None if self._device_psqt
+                    else np.zeros((width * size,), np.int32)
+                )
+                tabs = jax.device_put(
+                    jnp.zeros((width, rows_a, 2, spec.L1), jnp.int32)
+                )
+                ptabs = jax.device_put(
+                    jnp.zeros(
+                        (width, rows_a, 2, spec.NUM_PSQT_BUCKETS), jnp.int32
+                    )
+                )
+                values, _, _ = self._segmented_fn(
+                    self._params, packed, bucks, parents, material,
+                    tabs, np.full((width,), tier - 4, np.int32), ptabs,
+                )
+                np.asarray(values)
 
     def poke(self) -> None:
         """Wake the drivers (after setting a search's stop_event). Also
@@ -838,6 +1321,20 @@ class SearchService:
         out["wire_bytes"] = (
             out["wire_feature_bytes"] + out["wire_material_bytes"]
         )
+        # Dispatch coalescing: device dispatch calls actually issued
+        # (fused segmented dispatches count once), vs eval_steps above
+        # (per-group microbatches). eval_steps / dispatches is the
+        # average coalesce width.
+        co = self._coalescer
+        if co is not None:
+            with co._lock:
+                out["dispatches"] = co.dispatches
+                out["fused_dispatches"] = co.fused_dispatches
+                out["coalesced_steps"] = co.coalesced_steps
+        else:
+            out["dispatches"] = out["eval_steps"]
+            out["fused_dispatches"] = 0
+            out["coalesced_steps"] = 0
         return out
 
     def is_alive(self) -> bool:
@@ -906,6 +1403,17 @@ class SearchService:
 
     # -- evaluation -------------------------------------------------------
 
+    def _apply_acct(self, t: int, acct) -> None:
+        """Apply one dispatched microbatch's accounting to thread ``t``'s
+        cells. Always called on the OWNING driver thread (directly after
+        a solo dispatch, or at ticket-resolve time for batches another
+        thread flushed) — the per-thread cells stay single-writer."""
+        size, feature_bytes, material_bytes = acct
+        self._eval_steps[t] += 1
+        self._bucket_slots[t] += size
+        self._wire_feature_bytes[t] += feature_bytes
+        self._wire_material_bytes[t] += material_bytes
+
     def _dispatch_eval(self, group: int, n: int, rows: int):
         """Launch group `group`'s microbatch on the device WITHOUT waiting
         for the result — the returned jax array is resolved later by
@@ -916,15 +1424,17 @@ class SearchService:
         and (packed path) the smallest row tier covering `rows`. Each
         (bucket, tier) compiles once; a lightly-loaded step then
         transfers KBs, not the full batch_capacity buffer (the
-        host->device link is the bottleneck resource)."""
+        host->device link is the bottleneck resource).
+
+        Returns ``(values, acct)``: the in-flight array plus the
+        (bucket, feature-bytes, material-bytes) accounting triple the
+        OWNING thread applies via _apply_acct — dispatch may run on a
+        coalescer-flushing sibling thread, accounting may not."""
         size = self._eval_sizes[-1]
         for s in self._eval_sizes:
             if n <= s:
                 size = s
                 break
-        t = group // self.pipeline_depth  # owning thread's telemetry cell
-        self._eval_steps[t] += 1
-        self._bucket_slots[t] += size
         packed = self._packed_buf[group]
         offsets = self._offset_buf[group]
         buckets = self._bucket_buf[group]
@@ -954,9 +1464,11 @@ class SearchService:
             # (evaluate_packed_anchored). With device PSQT the material
             # column is off the wire too (its bytes are accounted
             # separately so BENCH shows the saving).
-            self._wire_feature_bytes[t] += tier * 2 * 8 * 2 + size * 2 * 4 + 4
-            if material is not None:
-                self._wire_material_bytes[t] += size * 4
+            acct = (
+                size,
+                tier * 2 * 8 * 2 + size * 2 * 4 + 4,
+                0 if material is None else size * 4,
+            )
             values, self._anchor_tabs[group], self._psqt_tabs[group] = (
                 self._eval_fn(
                     self._params, packed[:tier], buckets[:size],
@@ -966,10 +1478,10 @@ class SearchService:
                     self._psqt_tabs[group],
                 )
             )
-            return values
+            return values, acct
         if self._sharded_packed:
             return self._dispatch_sharded_packed(
-                t, size, n, rows, packed, offsets, buckets, parents, material
+                size, n, rows, packed, offsets, buckets, parents, material
             )
         # External evaluator (non-packed: test doubles, legacy meshes):
         # hand it the dense expansion.
@@ -978,14 +1490,13 @@ class SearchService:
         feats = expand_packed_np(
             packed[: rows + 4], offsets[:size], parents[:size]
         )
-        self._wire_feature_bytes[t] += feats.nbytes + size * 2 * 4
-        self._wire_material_bytes[t] += size * 4
+        acct = (size, feats.nbytes + size * 2 * 4, size * 4)
         return self._eval_fn(
             self._params, feats, buckets[:size], parents[:size],
             material[:size],
-        )
+        ), acct
 
-    def _dispatch_sharded_packed(self, t, size, n, rows, packed, offsets,
+    def _dispatch_sharded_packed(self, size, n, rows, packed, offsets,
                                  buckets, parents, material):
         """Repack the pool's row stream into a per-shard fixed row tier
         and ship it to the sharded evaluator's packed path.
@@ -1027,12 +1538,84 @@ class SearchService:
                 # Padding entries decode as all-sentinel fulls from the
                 # shard's own trailing sentinel block.
                 out_offsets[real_hi:hi] = tier - 4
-        self._wire_feature_bytes[t] += mult * tier * 2 * 8 * 2 + size * 3 * 4
-        self._wire_material_bytes[t] += size * 4
+        acct = (size, mult * tier * 2 * 8 * 2 + size * 3 * 4, size * 4)
         return self._eval_fn(
             self._params, out_packed, out_offsets, buckets[:size],
             parents[:size], material[:size],
+        ), acct
+
+    def _dispatch_segmented(self, tickets: List[_CoalesceTicket]) -> None:
+        """ONE device dispatch covering every ticket's group microbatch
+        (the coalescer's fused flush; doc/wire-format.md "Segmented
+        dispatch"). All segments share one entry bucket (the smallest
+        covering the largest n) and one row tier (the smallest covering
+        the largest emitted stream) so the fused program compiles once
+        per (segments, bucket, tier); each segment keeps its own
+        sentinel block and its parent codes stay segment-local — the
+        evaluator rebases them on device. Runs on whichever driver
+        thread triggered the flush: the owners' buffers are quiescent
+        (a group never steps again before resolving its ticket), and
+        each owner applies its own accounting from ticket.acct."""
+        size = self._eval_sizes[-1]
+        for s in self._eval_sizes:
+            if max(tk.n for tk in tickets) <= s:
+                size = s
+                break
+        need = max(tk.rows for tk in tickets) + 4
+        tier = self._row_tiers(size)[-1]
+        for rt in self._row_tiers(size):
+            if need <= rt:
+                tier = rt
+                break
+        material_cat = None
+        if self._material_buf is not None:
+            material_cat = np.empty((len(tickets), size), np.int32)
+        for k, tk in enumerate(tickets):
+            g, n, rows = tk.group, tk.n, tk.rows
+            # The same padding writes the solo path makes: sentinel
+            # block past the emitted rows, sentinel entries past n.
+            self._packed_buf[g][rows : rows + 4] = spec.NUM_FEATURES
+            self._bucket_buf[g][n:size] = 0
+            self._parent_buf[g][n:size] = -1
+            if material_cat is not None:
+                self._material_buf[g][n:size] = 0
+                material_cat[k] = self._material_buf[g][:size]
+        packed_cat = np.concatenate(
+            [self._packed_buf[tk.group][:tier] for tk in tickets]
         )
+        buckets_cat = np.concatenate(
+            [self._bucket_buf[tk.group][:size] for tk in tickets]
+        )
+        parents_cat = np.concatenate(
+            [self._parent_buf[tk.group][:size] for tk in tickets]
+        )
+        seg_rows = np.array([tk.rows for tk in tickets], np.int32)
+        # Stack the groups' device-resident tables for the dispatch and
+        # split them back after: device-side copies, never wire bytes —
+        # the trade this layer makes to pay ONE fixed transport cost.
+        import jax.numpy as jnp
+
+        stacked = jnp.stack([self._anchor_tabs[tk.group] for tk in tickets])
+        pstacked = jnp.stack([self._psqt_tabs[tk.group] for tk in tickets])
+        values, new_tabs, new_ptabs = self._segmented_fn(
+            self._params, packed_cat, buckets_cat, parents_cat,
+            None if material_cat is None else material_cat.reshape(-1),
+            stacked, seg_rows, pstacked,
+        )
+        # Per-segment wire accounting: each segment ships its tier of
+        # rows plus its entry scalars — the same formula as a solo
+        # dispatch at (size, tier), so the split is exact.
+        seg_feature_bytes = tier * 2 * 8 * 2 + size * 2 * 4 + 4
+        seg_material_bytes = 0 if material_cat is None else size * 4
+        shared = _FusedValues(values)
+        for k, tk in enumerate(tickets):
+            g = tk.group
+            self._anchor_tabs[g] = new_tabs[k]
+            self._psqt_tabs[g] = new_ptabs[k]
+            tk.values = shared
+            tk.start = k * size
+            tk.seg_size = size
+            tk.acct = (size, seg_feature_bytes, seg_material_bytes)
 
     def _resolve_eval(self, n: int, arr) -> np.ndarray:
         """Block until a dispatched eval is done; contiguous int32 [n]."""
@@ -1196,8 +1779,17 @@ class SearchService:
             stepped = 0
             for g in groups:
                 if g in inflight:
-                    n_prev, arr = inflight.pop(g)
+                    n_prev, handle = inflight.pop(g)
                     t0 = time.monotonic() if tel else 0.0
+                    if isinstance(handle, _CoalesceTicket):
+                        # Flushes the coalescer if this ticket is still
+                        # parked, then blocks until its dispatch lands;
+                        # the accounting rides the ticket so THIS thread
+                        # (the owner) applies it to its own cells.
+                        arr = self._coalescer.demand(handle)
+                        self._apply_acct(t, handle.acct)
+                    else:
+                        arr = handle
                     values = self._resolve_eval(n_prev, arr)
                     if tel:
                         _SPANS.record("wire_decode", t0, group=g, n=n_prev)
@@ -1241,7 +1833,17 @@ class SearchService:
                     if _faults.enabled():
                         _faults.fire("service.device_step")
                     t0 = time.monotonic() if tel else 0.0
-                    inflight[g] = (n, self._dispatch_eval(g, n, rows.value))
+                    if self._coalescer is not None:
+                        # Park the microbatch with the coalescer; it
+                        # dispatches fused with other ready groups (or
+                        # solo) by the time its ticket is demanded.
+                        inflight[g] = (
+                            n, self._coalescer.submit(g, n, rows.value)
+                        )
+                    else:
+                        values, acct = self._dispatch_eval(g, n, rows.value)
+                        self._apply_acct(t, acct)
+                        inflight[g] = (n, values)
                     if tel:
                         _SPANS.record("device_step", t0, group=g, n=n)
 

@@ -12,11 +12,14 @@ The six pipeline stage names are a stable contract
 * ``wire_decode`` — blocking on the dispatched array (wire + decode)
 * ``postprocess`` — provide values to fibers + harvest finished slots
 
-plus one *event* stage outside the pipeline (so it appears only when
-recovery machinery actually runs, never on a healthy serve):
+plus *event* stages outside the pipeline (each appears only when the
+named machinery actually runs):
 
 * ``recover``     — a supervised service rebuild: respawn and/or
   degradation-ladder step (resilience/supervisor.py)
+* ``coalesce``    — a FUSED device dispatch: several pipeline groups'
+  microbatches shipped as one segmented eval (search/service.py
+  _DispatchCoalescer; fields: width, groups, n)
 
 Recording is OFF by default: every instrumentation site is gated on
 ``fishnet_tpu.telemetry.enabled()``, so with telemetry disabled the
@@ -49,7 +52,7 @@ STAGES = (
 )
 
 #: Event stages: recorded only when the named machinery runs.
-EVENT_STAGES = ("recover",)
+EVENT_STAGES = ("recover", "coalesce")
 
 DEFAULT_CAPACITY = 4096  # spans kept per thread
 

@@ -1,0 +1,447 @@
+"""Coalesced multi-group dispatch: bit-exact parity of the segmented
+evaluator against per-group dispatch (all three psqt_path rungs, all
+wire entry kinds), deterministic width-policy units, and the
+``make coalesce-smoke`` contract — a low-occupancy mock workload run
+once coalesced and once with FISHNET_NO_COALESCE=1 must produce
+identical analyses while the coalesced run issues strictly fewer
+device dispatches than eval steps."""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fishnet_tpu.nnue import spec
+from fishnet_tpu.nnue.jax_eval import (
+    evaluate_packed_anchored,
+    evaluate_packed_anchored_segmented,
+    params_from_weights,
+)
+from fishnet_tpu.nnue.weights import NnueWeights
+from fishnet_tpu.search.service import (
+    DispatchProbe,
+    SearchService,
+    choose_coalesce_width,
+    fit_dispatch_cost,
+    suggest_pipeline_depth,
+)
+
+
+def _pers_code(aid, is_delta, swap=0):
+    """Wire anchor-entry codes (cpp/src/pool.cpp emit_block)."""
+    return -(2 + ((aid << 2) | (2 if is_delta else 0) | swap))
+
+
+def _delta_row(packed, rows, rng):
+    """One delta row: adds in [0, DELTA_SLOTS), removals after, each
+    region sentinel-padded."""
+    packed[rows, :, :2] = rng.integers(0, spec.NUM_FEATURES, (2, 2))
+    packed[rows, :, 2:4] = spec.NUM_FEATURES
+    packed[rows, :, 4] = spec.DELTA_BASE + rng.integers(
+        0, spec.NUM_FEATURES, (2,)
+    )
+    packed[rows, :, 5:8] = spec.DELTA_BASE + spec.NUM_FEATURES
+
+
+def _full_rows(packed, rows, rng):
+    for r in range(4):
+        packed[rows + r] = rng.integers(0, spec.NUM_FEATURES, (2, 8))
+
+
+def _make_segment(plan, size, tab_rows, rng):
+    """One group's packed stream from an entry plan. Plan items:
+    ("full",) plain full; ("store", aid) full anchor (re)seed;
+    ("pers", aid, swap) persistent anchor delta; ("inbatch", ref, swap)
+    in-batch delta vs segment-local entry ref. Entries past the plan
+    are padding. Returns the dict the dispatcher would ship."""
+    tier = 4 * size + 4
+    packed = np.full((tier, 2, 8), spec.NUM_FEATURES, np.uint16)
+    parent = np.full((size,), -1, np.int32)
+    rows = 0
+    for e, item in enumerate(plan):
+        kind = item[0]
+        if kind in ("full", "store"):
+            _full_rows(packed, rows, rng)
+            parent[e] = -1 if kind == "full" else _pers_code(item[1], False)
+            rows += 4
+        elif kind == "pers":
+            _delta_row(packed, rows, rng)
+            parent[e] = _pers_code(item[1], True, swap=item[2])
+            rows += 1
+        else:  # in-batch delta
+            _delta_row(packed, rows, rng)
+            parent[e] = (item[1] << 1) | item[2]
+            rows += 1
+    packed[rows : rows + 4] = spec.NUM_FEATURES  # the sentinel block
+    packed[rows + 4 :] = 60000  # stale garbage: must never be read
+    buckets = rng.integers(0, 8, (size,)).astype(np.int32)
+    buckets[len(plan) :] = 0
+    tab = rng.integers(-3000, 3000, (tab_rows, 2, spec.L1)).astype(np.int32)
+    ptab = rng.integers(
+        -2000, 2000, (tab_rows, 2, spec.NUM_PSQT_BUCKETS)
+    ).astype(np.int32)
+    return {
+        "n": len(plan), "rows": rows, "packed": packed, "parent": parent,
+        "buckets": buckets, "tab": tab, "ptab": ptab,
+    }
+
+
+#: Segments covering every wire entry kind: anchor seeds, persistent
+#: deltas (both swaps), in-batch chains off both anchor kinds, plain
+#: fulls, and (because n < size) padding entries.
+_PLANS = [
+    [("store", 0), ("inbatch", 0, 1), ("inbatch", 0, 0), ("full",)],
+    [("pers", 2, 1), ("inbatch", 0, 0), ("full",), ("store", 1),
+     ("inbatch", 3, 1)],
+    [("full",), ("pers", 3, 0), ("inbatch", 1, 1)],
+]
+
+#: The fused-interpret rung's plans (size 6, pallas chunk shrunk to 8):
+#: the chunk boundary falls at GLOBAL entry 8 = segment 1's local
+#: entry 2, an in-batch delta whose anchor (local entry 1, a plain
+#: full) sits in the PREVIOUS chunk — the carry-in path is genuinely
+#: read, mid-segment. Segment 0 ends with a padding entry.
+_INTERPRET_PLANS = [
+    [("store", 0), ("inbatch", 0, 1), ("pers", 2, 0), ("inbatch", 2, 1),
+     ("full",)],
+    [("store", 1), ("full",), ("inbatch", 1, 1), ("inbatch", 1, 0),
+     ("pers", 3, 1), ("inbatch", 4, 0)],
+]
+
+RUNGS = ["xla", "fused-interpret", "host-material"]
+
+
+@pytest.mark.parametrize("rung", RUNGS)
+def test_segmented_matches_per_group_dispatch(rung, monkeypatch):
+    """The tentpole invariant: ONE segmented dispatch over K group
+    streams (stacked tables, per-segment row scalars, segment-local
+    parent codes) returns, segment by segment, exactly the values and
+    updated tables of K separate per-group dispatches — on every
+    psqt_path rung.
+
+    The fused-interpret rung runs with a shrunken pallas chunk and
+    plans placing a delta right after a mid-segment chunk boundary
+    (_INTERPRET_PLANS): the kernel's carry-in must hand each chunk the
+    right running anchor across both chunk AND segment boundaries."""
+    rng = np.random.default_rng(31)
+    params = params_from_weights(NnueWeights.random(seed=5))
+    size, tab_rows = 6, 4
+    if rung == "fused-interpret":
+        from fishnet_tpu.ops import ft_gather
+
+        monkeypatch.setattr(ft_gather, "_CHUNK", 8)
+        kw = {"interpret": True}
+        plans = _INTERPRET_PLANS
+    else:
+        kw = {"use_pallas": False}
+        plans = _PLANS
+    tier = 4 * size + 4
+    segs = [_make_segment(p, size, tab_rows, rng) for p in plans]
+    for s in segs:
+        s["mat"] = (
+            rng.integers(-400, 400, (size,)).astype(np.int32)
+            if rung == "host-material" else None
+        )
+
+    # Per-group references always run the XLA executor: every rung is
+    # bit-identical per group (test_ops pins interpret == XLA at the op
+    # level), so XLA refs prove the coalesced interpret dispatch
+    # against per-group dispatch too — without paying a second
+    # interpreter trace for the reference side.
+    refs = []
+    for s in segs:
+        v, nt, npt = evaluate_packed_anchored(
+            params, jnp.asarray(s["packed"]), jnp.asarray(s["buckets"]),
+            jnp.asarray(s["parent"]),
+            None if s["mat"] is None else jnp.asarray(s["mat"]),
+            jnp.asarray(s["tab"]),
+            jnp.asarray(np.array([s["rows"]], np.int32)),
+            jnp.asarray(s["ptab"]), use_pallas=False,
+        )
+        refs.append((np.asarray(v), np.asarray(nt), np.asarray(npt)))
+
+    packed_cat = np.concatenate([s["packed"][:tier] for s in segs])
+    mats = None
+    if rung == "host-material":
+        mats = jnp.asarray(np.concatenate([s["mat"] for s in segs]))
+    got_v, got_t, got_pt = evaluate_packed_anchored_segmented(
+        params, jnp.asarray(packed_cat),
+        jnp.asarray(np.concatenate([s["buckets"] for s in segs])),
+        jnp.asarray(np.concatenate([s["parent"] for s in segs])),
+        mats,
+        jnp.asarray(np.stack([s["tab"] for s in segs])),
+        jnp.asarray(np.array([s["rows"] for s in segs], np.int32)),
+        jnp.asarray(np.stack([s["ptab"] for s in segs])), **kw,
+    )
+    got_v, got_t, got_pt = map(np.asarray, (got_v, got_t, got_pt))
+    for k, s in enumerate(segs):
+        ref_v, ref_t, ref_pt = refs[k]
+        assert np.array_equal(
+            got_v[k * size : k * size + s["n"]], ref_v[: s["n"]]
+        ), (rung, k)
+        assert np.array_equal(got_t[k], ref_t), (rung, k, "anchor tab")
+        assert np.array_equal(got_pt[k], ref_pt), (rung, k, "psqt tab")
+
+
+def test_segment_helper_offsets_and_recode():
+    """The device-side segment helpers against hand-built expectations:
+    offsets clamp into each segment's own sentinel block and shift by
+    its tier; parent codes rebase entry and table bases per segment."""
+    from fishnet_tpu.ops.ft_gather import (
+        derive_segment_offsets,
+        recode_segment_parents,
+    )
+
+    # Two segments of 3 entries: [full, inbatch(0), pad] and
+    # [store(1), pers(2,swap), pad].
+    parent = np.array(
+        [[-1, (0 << 1) | 1, -1],
+         [_pers_code(1, False), _pers_code(2, True, 1), -1]], np.int32
+    )
+    seg_rows = np.array([5, 5], np.int32)
+    tier = 12
+    off = np.asarray(
+        derive_segment_offsets(jnp.asarray(parent), jnp.asarray(seg_rows), tier)
+    )
+    # seg 0: full at 0, delta at 4, padding full clamps to seg_rows=5.
+    # seg 1 (base 12): store-full at 12, pers delta at 16, pad at 17.
+    assert off.tolist() == [0, 4, 5, 12, 16, 17]
+
+    A = 4
+    rec = np.asarray(
+        recode_segment_parents(jnp.asarray(parent), A)
+    ).reshape(2, 3)
+    assert rec[0].tolist() == [-1, (0 << 1) | 1, -1]  # seg 0 unchanged
+    # seg 1: table rows shift by A (1 -> 5, 2 -> 6), swap bit kept.
+    assert rec[1, 0] == _pers_code(1 + A, False)
+    assert rec[1, 1] == _pers_code(2 + A, True, 1)
+    assert rec[1, 2] == -1
+
+
+# -- width policy: probe numbers in -> width out ----------------------------
+
+
+def test_fit_dispatch_cost_decomposes_bench_transport():
+    # BENCH_r05's measured transport tier: rtt_ms_256 ~104,
+    # rtt_ms_16384 ~399 -> a ~99 ms fixed term, ~18.7 ms/kslot marginal.
+    p = fit_dispatch_cost(0.104, 0.399, 256, 16384)
+    assert 90 < p.fixed_ms < 105
+    assert 17 < p.marginal_ms_per_kslot < 20
+    assert (p.small, p.big) == (256, 16384)
+
+
+def test_fit_dispatch_cost_clamps_noise():
+    # Jitter making the big batch "faster" must not go negative.
+    p = fit_dispatch_cost(0.100, 0.080, 256, 16384)
+    assert p.marginal_ms_per_kslot == 0.0
+    assert p.fixed_ms == 100.0
+
+
+@pytest.mark.parametrize(
+    "fixed,marginal,slots,n_groups,expected",
+    [
+        # Tunnel probe, low occupancy: fixed dominates -> fuse wide
+        # (floored to a power of two).
+        (99.0, 18.7, 800, 8, 4),
+        (99.0, 18.7, 100, 8, 8),
+        # Same probe at full 16k batches: payload dwarfs fixed -> solo.
+        (99.0, 18.7, 16384, 8, 1),
+        # Mid occupancy: one doubling's worth of fusing.
+        (99.0, 18.7, 4096, 8, 2),
+        # Local chip (sub-ms fixed cost): never coalesce.
+        (0.0, 18.7, 100, 8, 1),
+        # Degenerate probe (single-bucket service): assume
+        # fixed-dominated, fuse to the group limit.
+        (3.0, 0.0, 500, 4, 4),
+        # One group: nothing to fuse, whatever the numbers say.
+        (99.0, 18.7, 100, 1, 1),
+        # The MAX_WIDTH-style cap clamps before the power-of-two floor.
+        (1000.0, 0.1, 10, 32, 8),
+    ],
+)
+def test_choose_coalesce_width(fixed, marginal, slots, n_groups, expected):
+    assert choose_coalesce_width(fixed, marginal, slots, n_groups) == expected
+
+
+def test_suggest_pipeline_depth_returns_probe():
+    """return_probe=True: the startup probe reports the fixed/marginal
+    decomposition alongside the depth, through the same harness."""
+    calls = []
+
+    def instant_eval(params, feats, buckets):
+        calls.append(len(buckets))
+        return np.zeros((len(buckets),), np.int32)
+
+    depth, probe = suggest_pipeline_depth(
+        None, size=1024, rounds=3, eval_fn=instant_eval, return_probe=True
+    )
+    assert depth in (1, 2, 4)
+    assert isinstance(probe, DispatchProbe)
+    assert probe.small == 64 and probe.big == 1024
+    assert probe.fixed_ms >= 0 and probe.marginal_ms_per_kslot >= 0
+    assert 64 in calls and 1024 in calls
+
+
+# -- service wiring ----------------------------------------------------------
+
+
+def test_no_coalesce_env_disables_layer(monkeypatch):
+    monkeypatch.setenv("FISHNET_NO_COALESCE", "1")
+    svc = SearchService(
+        weights=NnueWeights.random(seed=3), pool_slots=8,
+        batch_capacity=128, tt_bytes=4 << 20, backend="jax",
+        pipeline_depth=2,
+    )
+    try:
+        assert svc._coalescer is None
+        c = svc.counters()
+        assert c["dispatches"] == c["eval_steps"]
+    finally:
+        svc.close()
+
+
+def test_single_group_service_builds_no_coalescer():
+    svc = SearchService(
+        weights=NnueWeights.random(seed=3), pool_slots=8,
+        batch_capacity=64, tt_bytes=4 << 20, backend="jax",
+    )
+    try:
+        assert svc._coalescer is None
+    finally:
+        svc.close()
+
+
+# -- the coalesce-smoke contract (make coalesce-smoke) -----------------------
+
+
+_SMOKE_FENS = [
+    "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+    "r1bqkbnr/pppp1ppp/2n5/4p3/4P3/5N2/PPPP1PPP/RNBQKB1R w KQkq - 2 3",
+    "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
+    "4rrk1/pp1n3p/3q2pQ/2p1pb2/2PP4/2P3N1/P2B2PP/4RRK1 b - - 7 19",
+    "r3r1k1/2p2ppp/p1p1bn2/8/1q2P3/2NPQN2/PPP3PP/R4RK1 b - - 2 15",
+    "2rq1rk1/1p3ppp/p2p1n2/2bPp3/4P1b1/2N2N2/PPQ1BPPP/R1B2RK1 w - - 0 12",
+    "r1bqk2r/ppp2ppp/2np1n2/2b1p3/2B1P3/2PP1N2/PP3PPP/RNBQK2R w KQkq - 0 6",
+    "r2q1rk1/ppp2ppp/2npbn2/2b1p3/4P3/2PP1NN1/PPB2PPP/R1BQ1RK1 w - - 6 9",
+]
+
+
+class _GatedService(SearchService):
+    """SearchService whose driver parks after warmup until the gate
+    opens — every smoke submission lands in ONE drain pass, making the
+    whole schedule (slot assignment, stepping order, TT evolution) a
+    deterministic function of the submission sequence. With bit-
+    identical eval values, the coalesced and uncoalesced runs then walk
+    the exact same search trees."""
+
+    def __init__(self, *args, **kwargs):
+        self.gate = threading.Event()
+        super().__init__(*args, **kwargs)
+
+    def warmup(self):
+        super().warmup()
+        self.gate.wait()
+
+
+def _smoke_run(weights):
+    svc = _GatedService(
+        weights=weights, pool_slots=8, batch_capacity=256,
+        tt_bytes=8 << 20, backend="jax", pipeline_depth=4,
+        driver_threads=1,
+    )
+    try:
+        # Pin speculation so TT insertions are schedule-deterministic
+        # (the cross-backend parity suites' discipline).
+        svc.set_prefetch(0, adaptive=False)
+
+        async def go():
+            tasks = [
+                asyncio.ensure_future(svc.search(fen, [], nodes=280))
+                for fen in _SMOKE_FENS
+            ]
+            await asyncio.sleep(0.3)  # let every submission queue
+            svc.gate.set()
+            return await asyncio.gather(*tasks)
+
+        results = asyncio.run(go())
+        analyses = [
+            (
+                r.best_move, r.depth, r.nodes,
+                tuple(
+                    (l.multipv, l.depth, l.is_mate, l.value, tuple(l.pv))
+                    for l in r.lines
+                ),
+            )
+            for r in results
+        ]
+        return analyses, svc.counters()
+    finally:
+        svc.gate.set()  # never leave the driver parked on a failure
+        svc.close()
+
+
+def test_fused_flush_failure_reaches_every_owner(monkeypatch):
+    """A device failure inside a coalesced flush must surface on every
+    owning driver exactly like a solo dispatch failure: drivers crash,
+    outstanding futures fail, and the service reads dead — the
+    supervisor's respawn + degradation ladder sees nothing new."""
+    from fishnet_tpu.chess.core import NativeCoreError
+
+    monkeypatch.setenv("FISHNET_COALESCE_WIDTH", "4")
+    weights = NnueWeights.random(seed=7)
+    svc = _GatedService(
+        weights=weights, pool_slots=8, batch_capacity=256,
+        tt_bytes=8 << 20, backend="jax", pipeline_depth=4,
+        driver_threads=1,
+    )
+    try:
+        def boom(*args, **kwargs):
+            raise RuntimeError("injected segmented-dispatch failure")
+
+        svc._segmented_fn = boom
+        svc._dispatch_eval = boom  # solo flushes die identically
+
+        async def go():
+            tasks = [
+                asyncio.ensure_future(svc.search(fen, [], nodes=280))
+                for fen in _SMOKE_FENS
+            ]
+            await asyncio.sleep(0.3)
+            svc.gate.set()
+            return await asyncio.gather(*tasks, return_exceptions=True)
+
+        results = asyncio.run(go())
+        assert all(isinstance(r, NativeCoreError) for r in results)
+        assert not svc.is_alive()
+    finally:
+        svc.gate.set()
+        svc.close()
+
+
+def test_coalesce_smoke(monkeypatch):
+    """Acceptance: under a low-occupancy mock workload (8 concurrent
+    searches spread over 4 pipeline groups, tiny per-step batches) the
+    coalesced run issues strictly fewer device dispatches than eval
+    steps, with analysis output identical to FISHNET_NO_COALESCE=1."""
+    weights = NnueWeights.random(seed=7)
+    monkeypatch.setenv("FISHNET_COALESCE_WIDTH", "4")  # pin: no timing
+    coalesced, c1 = _smoke_run(weights)
+    monkeypatch.delenv("FISHNET_COALESCE_WIDTH")
+    monkeypatch.setenv("FISHNET_NO_COALESCE", "1")
+    plain, c2 = _smoke_run(weights)
+
+    assert coalesced == plain, "coalescing changed analysis output"
+    assert c1["eval_steps"] == c2["eval_steps"]
+    assert c1["dispatches"] < c1["eval_steps"]
+    assert c1["fused_dispatches"] >= 1
+    assert c2["dispatches"] == c2["eval_steps"]
+    assert c2["fused_dispatches"] == 0
+
+    # The width histogram family is exported (doc/observability.md).
+    from fishnet_tpu import telemetry
+
+    text = telemetry.REGISTRY.render_prometheus()
+    assert "# TYPE fishnet_dispatch_coalesce_width histogram" in text
