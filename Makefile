@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke coalesce-smoke
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke coalesce-smoke async-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
@@ -34,6 +34,17 @@ soak-smoke:
 # steps.
 coalesce-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_coalesce.py -q
+
+# Async double-buffered dispatch contract (≤60 s subset of
+# tests/test_async_dispatch.py): sync-vs-async bit parity on the xla
+# rung, ping-pong donation correctness (never >2 dispatches in
+# flight), the FISHNET_NO_ASYNC escape hatch, and the overlap smoke
+# (overlap_ratio > 0 with dispatch_issue/dispatch_wait spans
+# recorded). The full file — all rungs, fault ladder, wire-diet
+# planner units — runs in tier-1.
+async-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_async_dispatch.py -q \
+		-k "xla or overlap or ping_pong or no_async_env"
 
 # ASan+UBSan pool stress incl. the anchor full-provide guard case —
 # the non-tier-1 `slow` job.

@@ -31,6 +31,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <memory>
@@ -60,8 +61,12 @@ struct Slot;
 class BatchedEval : public EvalBridge {
  public:
   BatchedEval(Slot* slot, const NnueNet* net, const std::atomic<int>* budget,
-              const bool* anchors)
-      : slot_(slot), net_(net), budget_(budget), anchors_(anchors) {}
+              const bool* anchors, const bool* placement)
+      : slot_(slot),
+        net_(net),
+        budget_(budget),
+        anchors_(anchors),
+        placement_(placement) {}
   int evaluate(const Position& pos) override;
   void evaluate_block(const Position* positions, int n, int32_t* out) override;
   bool batched() const override { return true; }
@@ -77,6 +82,9 @@ class BatchedEval : public EvalBridge {
   // Pool-level persistent-anchor switch (set once by the service before
   // traffic; read-only afterwards).
   const bool* anchors_;
+  // Pool-level anchor-placement switch (FISHNET_NO_ANCHOR_PLACEMENT
+  // disables the block-reorder policy; read-only after pool creation).
+  const bool* placement_;
 };
 
 struct Slot {
@@ -247,6 +255,89 @@ bool fill_delta(Slot* slot, const NnueNet* net, int j, const Position& ref,
   return true;
 }
 
+// Exact cheap predictor of fill_delta success: both kings unmoved (a
+// moved king re-bases that perspective's whole feature set) and no more
+// than NNUE_DELTA_SLOTS added or removed pieces. The piece-diff counts
+// are perspective-independent (fill_delta counts ALL board diffs for
+// each perspective), so one scan answers for both.
+bool can_delta(const Position& ref, const Position& pos) {
+  if (ref.king_sq(WHITE) != pos.king_sq(WHITE) ||
+      ref.king_sq(BLACK) != pos.king_sq(BLACK))
+    return false;
+  int n_add = 0, n_rem = 0;
+  for (int s = 0; s < 64; s++) {
+    int before = ref.piece_on(Square(s));
+    int after = pos.piece_on(Square(s));
+    if (before == after) continue;
+    if (before != NO_PIECE && ++n_rem > NNUE_DELTA_SLOTS) return false;
+    if (after != NO_PIECE && ++n_add > NNUE_DELTA_SLOTS) return false;
+  }
+  return true;
+}
+
+// ANCHOR-PLACEMENT POLICY (the wire diet): a deterministic permutation
+// of one eval chunk chosen to maximize delta-encodable entries.
+//
+// The fill loop below encodes entry k as a delta when fill_delta against
+// the running anchor succeeds; a failure makes k a full entry AND the
+// new anchor. In search emission order one mid-block king-move child
+// resets the anchor and cascades fulls over entries that could have
+// delta'd against the previous anchor. Since fill_delta requires equal
+// king squares for BOTH colors, entries sharing a (white king, black
+// king) pair are laid out contiguously — groups ordered by first
+// occurrence, original order preserved within a group — so each king
+// pair costs at most one full fill instead of one per alternation.
+//
+// The persistent-anchor candidate: entry 0 may ship as a one-row delta
+// against the slot's device-resident anchor, but only entry 0 may carry
+// a persistent code. The old code only ever tried positions[0]; here
+// the WHOLE chunk is scanned for the first entry delta-encodable
+// against the device anchor, and that entry's group leads with it at
+// its head — the anchor_coverage lever.
+//
+// Deterministic: a pure function of (positions, device_anchor), no
+// randomness, no iteration-order dependence.
+void plan_block_order(const Position* positions, int chunk,
+                      const Position* device_anchor, int* order) {
+  int group_of[EVAL_BLOCK_MAX];
+  int first_of[EVAL_BLOCK_MAX];
+  int n_keys = 0;
+  for (int j = 0; j < chunk; j++) {
+    int g = -1;
+    for (int k = 0; k < n_keys; k++) {
+      const Position& rep = positions[first_of[k]];
+      if (rep.king_sq(WHITE) == positions[j].king_sq(WHITE) &&
+          rep.king_sq(BLACK) == positions[j].king_sq(BLACK)) {
+        g = k;
+        break;
+      }
+    }
+    if (g < 0) {
+      g = n_keys++;
+      first_of[g] = j;
+    }
+    group_of[j] = g;
+  }
+  int j0 = -1;
+  if (device_anchor) {
+    for (int j = 0; j < chunk; j++)
+      if (can_delta(*device_anchor, positions[j])) {
+        j0 = j;
+        break;
+      }
+  }
+  int lead = j0 >= 0 ? group_of[j0] : 0;  // group 0 starts at entry 0
+  int w = 0;
+  if (j0 >= 0) order[w++] = j0;
+  for (int j = 0; j < chunk; j++)
+    if (group_of[j] == lead && j != j0) order[w++] = j;
+  for (int g = 0; g < n_keys; g++) {
+    if (g == lead) continue;
+    for (int j = 0; j < chunk; j++)
+      if (group_of[j] == g) order[w++] = j;
+  }
+}
+
 }  // namespace
 
 void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out) {
@@ -264,29 +355,42 @@ void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out)
     // single demand evals then ship 32 bytes instead of 128. A failed
     // delta (king moved, too many diffs) becomes full and the new
     // in-block anchor.
+    const Position* danchor =
+        (*anchors_ && slot_->anchor_valid) ? &slot_->anchor_pos : nullptr;
+    // Anchor-placement reorder (plan_block_order): fill the block in a
+    // permuted order chosen to maximize delta encodings; `order[k]` is
+    // the caller index filled at block entry k, and the result copy-out
+    // applies the inverse map. Disabled (identity order) via
+    // FISHNET_NO_ANCHOR_PLACEMENT — the pre-policy layout.
+    int order[EVAL_BLOCK_MAX];
+    if (*placement_ && chunk > 1) {
+      plan_block_order(positions + base, chunk, danchor, order);
+    } else {
+      for (int j = 0; j < chunk; j++) order[j] = j;
+    }
     int last_anchor = 0;
-    for (int j = 0; j < chunk; j++) {
-      const Position& pos = positions[base + j];
-      if (j == 0) {
-        if (!(*anchors_ && slot_->anchor_valid &&
-              fill_delta(slot_, net_, 0, slot_->anchor_pos, pos,
-                         slot_->anchor_psqt, /*ref_entry=*/-1)))
+    for (int k = 0; k < chunk; k++) {
+      const Position& pos = positions[base + order[k]];
+      if (k == 0) {
+        if (!(danchor && fill_delta(slot_, net_, 0, *danchor, pos,
+                                    slot_->anchor_psqt, /*ref_entry=*/-1)))
           fill_full(slot_, net_, 0, pos);
-      } else if (!fill_delta(slot_, net_, j, positions[base + last_anchor],
-                             pos, slot_->psqt[last_anchor], last_anchor)) {
-        fill_full(slot_, net_, j, pos);
-        last_anchor = j;
+      } else if (!fill_delta(slot_, net_, k,
+                             positions[base + order[last_anchor]], pos,
+                             slot_->psqt[last_anchor], last_anchor)) {
+        fill_full(slot_, net_, k, pos);
+        last_anchor = k;
       }
-      slot_->buckets[j] = nnue_psqt_bucket(pos);
-      slot_->material[j] =
-          (slot_->psqt[j][0][slot_->buckets[j]] -
-           slot_->psqt[j][1][slot_->buckets[j]]) / 2;
+      slot_->buckets[k] = nnue_psqt_bucket(pos);
+      slot_->material[k] =
+          (slot_->psqt[k][0][slot_->buckets[k]] -
+           slot_->psqt[k][1][slot_->buckets[k]]) / 2;
     }
     if (*anchors_) {
-      // Entry 0 becomes the slot's device anchor once this block ships
-      // (emit_block finalizes; see the Slot field comment).
+      // Block entry 0 becomes the slot's device anchor once this block
+      // ships (emit_block finalizes; see the Slot field comment).
       slot_->pending_anchor_valid = true;
-      slot_->pending_pos = positions[base];
+      slot_->pending_pos = positions[base + order[0]];
       memcpy(slot_->pending_psqt, slot_->psqt[0], sizeof(slot_->pending_psqt));
     }
     slot_->block_n = chunk;
@@ -294,7 +398,9 @@ void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out)
     slot_->fiber->yield();
     slot_->wants_eval = false;
     slot_->block_n = 0;
-    for (int j = 0; j < chunk; j++) out[base + j] = slot_->eval_values[j];
+    // eval_values is in fill (wire) order: undo the permutation.
+    for (int k = 0; k < chunk; k++)
+      out[base + order[k]] = slot_->eval_values[k];
   }
 }
 
@@ -325,6 +431,10 @@ struct SearchPool {
   // when its evaluator understands the anchor-table wire codes; plain
   // bool because it is read-only while fibers run.
   bool anchors_enabled = false;
+  // Anchor-placement reorder switch (evaluate_block plan_block_order):
+  // set once at pool creation from FISHNET_NO_ANCHOR_PLACEMENT,
+  // read-only afterwards.
+  bool anchor_placement = true;
   // Adaptive speculation budget (max speculative evals per prefetch
   // block). Halved whenever a step overflows capacity — wasted slots
   // then displace other fibers' demand evals — and grown back while
@@ -401,6 +511,10 @@ SearchPool* fc_pool_new(int max_slots, uint64_t tt_bytes,
       max_slots > 0 ? max_slots : 256,
       tt_bytes ? size_t(tt_bytes) : (64ull << 20), n_groups);
   if (!pool) return nullptr;
+  // Escape hatch for the block-reorder anchor-placement policy
+  // (evaluate_block): restores the pre-policy search-emission layout.
+  const char* no_placement = std::getenv("FISHNET_NO_ANCHOR_PLACEMENT");
+  pool->anchor_placement = !(no_placement && no_placement[0] == '1');
   if (scalar_net_path && scalar_net_path[0]) {
     pool->scalar_net = std::make_unique<NnueNet>();
     if (!pool->scalar_net->load(scalar_net_path).empty()) {
@@ -500,7 +614,7 @@ int fc_pool_submit(SearchPool* pool, int group, const char* fen,
   if (!slot.bridge)
     slot.bridge = std::make_unique<BatchedEval>(
         &slot, pool->scalar_net.get(), &pool->prefetch_budget,
-        &pool->anchors_enabled);
+        &pool->anchors_enabled, &pool->anchor_placement);
   return id;
 }
 

@@ -54,6 +54,7 @@ __all__ = [
     "ft_accumulate",
     "derive_segment_offsets",
     "recode_segment_parents",
+    "plan_segment_dedup",
 ]
 
 #: Accumulator poison for persistent anchor codes evaluated WITHOUT an
@@ -181,6 +182,91 @@ def recode_segment_parents(parent: jax.Array, anchor_rows: int) -> jax.Array:
     out = jnp.where(parent >= 0, parent + (entry_base << 1), parent)
     out = jnp.where(parent <= -2, parent - (tab_base << 2), out)
     return out.reshape(-1)
+
+
+def plan_segment_dedup(parents, buckets, offsets, ns, packed, material=None):
+    """Plan cross-segment eval-dedup for ONE fused (coalesced) dispatch:
+    deterministic, pure host-side planning (numpy in, plain lists out).
+
+    Per-slot emission cannot see these duplicates — the in-step dedup
+    was DELETED per VERDICT r4 because WITHIN one group it retired only
+    ~0.1% of evals while its hash build sat on the per-step hot path —
+    but ACROSS the segments of one fused dispatch, sibling groups
+    searching adjacent plies of the same game routinely evaluate the
+    same transpositions in the same step. Here the planning cost rides
+    the async pack worker, off the driver threads entirely.
+
+    Inputs are per-segment host views (only the first ``ns[k]`` entries
+    of each are read):
+
+    * ``parents``: int32 [size] segment-local wire parent codes
+    * ``buckets``: int32 [size] layer-stack bucket ids
+    * ``offsets``: int32 [size] each entry's row offset into its
+      segment's packed stream (the host copy; the device re-derives)
+    * ``ns``: real entry counts
+    * ``packed``: uint16 [rows_k, 2, 8] row streams
+    * ``material``: optional int32 [size] host-material columns
+
+    A DUPLICATE is a plain full (code -1) whose 4-row feature block —
+    keyed with its bucket (and material when shipped) — matches an
+    earlier 4-row entry anywhere in the dispatch, provided it has no
+    in-batch consumer and is not its segment's first entry. The anchor
+    protocol makes removal safe: a full with no consumer is, by the
+    most-recent-anchor rule, immediately followed by another anchor
+    entry (or padding), so re-encoding it as a one-row sentinel
+    in-batch delta never disturbs any other entry's resolution — the
+    replacement computes garbage on device and its true value is
+    restored host-side from its original (_FusedValues).
+
+    Returns ``(drops, refs, pairs)``: per-segment lists of dropped
+    entry indices, the matching in-batch anchor refs for the
+    replacement codes (``ref << 1``, swap 0 — the most recent preceding
+    KEPT anchor, always present since entry 0 is an anchor and never
+    dropped), and global ``(dst_seg, dst_idx, src_seg, src_idx)`` value
+    overwrites (every duplicate maps to the FIRST occurrence, which is
+    by construction never itself dropped)."""
+    import numpy as np
+
+    n_segs = len(parents)
+    seen = {}
+    drops = [[] for _ in range(n_segs)]
+    refs = [[] for _ in range(n_segs)]
+    pairs = []
+    for k in range(n_segs):
+        n = int(ns[k])
+        if n <= 0:
+            continue
+        p = np.asarray(parents[k][:n])
+        consumed = np.zeros(n, dtype=bool)
+        inb = p >= 0
+        if inb.any():
+            consumed[p[inb] >> 1] = True
+        # Anchor entries (fulls and persistent codes) vs 4-row entries
+        # (fulls and persistent FULLS — persistent deltas ship 1 row).
+        is_anchor = (p == -1) | (p <= -2)
+        is_full4 = (p == -1) | ((p <= -2) & ((((-p - 2) >> 1) & 1) == 0))
+        off = np.asarray(offsets[k][:n])
+        rows = packed[k]
+        last_anchor = 0
+        for i in range(n):
+            dropped = False
+            if is_full4[i]:
+                key = (int(buckets[k][i]),
+                       rows[off[i] : off[i] + 4].tobytes())
+                if material is not None:
+                    key = key + (int(material[k][i]),)
+                src = seen.get(key)
+                if (src is not None and p[i] == -1
+                        and not consumed[i] and i > 0):
+                    drops[k].append(i)
+                    refs[k].append(last_anchor)
+                    pairs.append((k, i, src[0], src[1]))
+                    dropped = True
+                elif src is None:
+                    seen[key] = (k, i)
+            if not dropped and is_anchor[i]:
+                last_anchor = i
+    return drops, refs, pairs
 
 
 def _xla_resolve_parents(

@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import ctypes
 import os
+import queue
 import threading
 import time
 import weakref
@@ -335,6 +336,17 @@ _COUNTER_METRICS = {
                             "Host->device material payload bytes shipped."),
     "wire_bytes": ("fishnet_service_wire_bytes_total", "counter",
                    "Total host->device payload bytes shipped."),
+    "fused_dedup": ("fishnet_fused_dedup_total", "counter",
+                    "Eval entries deduplicated across segments of fused "
+                    "dispatches (duplicate plain fulls shipped as one-row "
+                    "sentinel deltas; values restored host-side)."),
+    "inflight_dispatches": ("fishnet_inflight_dispatches", "gauge",
+                            "Device dispatches currently in flight in the "
+                            "async pipeline (0..2: the ping-pong double "
+                            "buffer's depth)."),
+    "async_ready_queue": ("fishnet_dispatch_ready_queue_depth", "gauge",
+                          "Flush batches queued in front of the async "
+                          "pack/decode workers."),
 }
 
 
@@ -370,6 +382,17 @@ def _register_service_collector(svc: "SearchService") -> int:
             "fishnet_service_eval_steps_total; pair with "
             "fishnet_dispatches_total for the coalesce ratio).",
             counters.get("eval_steps", 0),
+        ))
+        # Live dispatch-overlap ratio from the async pipeline: the
+        # fraction of dispatch-busy wall time with >=2 dispatches in
+        # flight (1.0 = every dispatch fully hidden behind another;
+        # 0 = the synchronous loop, or no async pipeline at all).
+        pipe = service._async_pipe
+        fams.append(_telemetry.gauge_family(
+            "fishnet_dispatch_overlap_ratio",
+            "Fraction of dispatch-busy wall time with >=2 device "
+            "dispatches in flight (async pipeline; 0 when synchronous).",
+            pipe.overlap_ratio() if pipe is not None else 0.0,
         ))
         with service._lock:
             pending = sum(len(p) for p in service._pending)
@@ -423,19 +446,34 @@ class _FusedValues:
     """One fused dispatch's [K*size] value array, materialized to host
     ONCE — a single device->host transfer shared by every segment
     owner, instead of K per-slice fetches that would hand back K round
-    trips on the high-latency links coalescing exists to spare."""
+    trips on the high-latency links coalescing exists to spare.
 
-    __slots__ = ("_arr", "_np", "_lock")
+    ``dups`` carries the cross-segment eval-dedup restore plan
+    (doc/wire-format.md "Eval-dedup across segments"): each duplicate
+    entry rode the wire as a one-row sentinel delta and computed
+    garbage on device; its true value is its original's, patched here
+    so every consumer — owner slice or eager decode worker — sees the
+    restored array."""
 
-    def __init__(self, arr) -> None:
+    __slots__ = ("_arr", "_np", "_lock", "_dups")
+
+    def __init__(self, arr, dups=None) -> None:
         self._arr = arr
         self._np = None
+        self._dups = dups  # [(dst_flat, src_flat)] value overwrites
         self._lock = threading.Lock()
 
     def materialize(self) -> np.ndarray:
         with self._lock:
             if self._np is None:
-                self._np = np.asarray(self._arr)
+                arr = np.asarray(self._arr)
+                if self._dups:
+                    # np.asarray can hand back a read-only view of
+                    # device memory — copy before patching.
+                    arr = np.array(arr, copy=True)
+                    for dst, src in self._dups:
+                        arr[dst] = arr[src]
+                self._np = arr
                 self._arr = None
             return self._np
 
@@ -527,6 +565,7 @@ class _DispatchCoalescer:
         self.dispatches = 0
         self.fused_dispatches = 0
         self.coalesced_steps = 0
+        self.deduped_evals = 0
 
     def set_probe(self, probe: DispatchProbe) -> None:
         with self._lock:
@@ -603,6 +642,16 @@ class _DispatchCoalescer:
         return values
 
     def _flush(self, tickets: List[_CoalesceTicket]) -> None:
+        """Dispatch a flush batch. With the async pipeline up this is
+        pure SCHEDULING — the batch is handed to the pack worker and
+        executes off the driver threads; synchronously (FISHNET_NO_ASYNC,
+        or a dead pipeline) it executes inline, exactly the PR 5 loop."""
+        pipe = self._svc._async_pipe
+        if pipe is not None and pipe.submit(tickets):
+            return
+        self._execute(tickets)
+
+    def _execute(self, tickets: List[_CoalesceTicket]) -> None:
         svc = self._svc
         tel = _telemetry.enabled()
         t0 = time.monotonic() if tel else 0.0
@@ -634,6 +683,230 @@ class _DispatchCoalescer:
                 groups=[tk.group for tk in tickets],
                 n=sum(tk.n for tk in tickets),
             )
+
+
+class _AsyncDispatchPipeline:
+    """Double-buffered async dispatch: dedicated pack and decode worker
+    threads that turn the coalescer's flushes into a two-deep in-flight
+    pipeline (ROADMAP open item 2; the successor to PR 5's coalescer).
+
+    The coalescer stays the SCHEDULING stage — it still decides which
+    group microbatches fuse into which dispatch — but executing a flush
+    moves off the driver threads onto the PACK worker, which stages the
+    wire (concatenation, padding, cross-segment eval-dedup), issues the
+    JAX dispatch (asynchronous: the call returns once the transfer is
+    enqueued), rebinds the donated anchor/PSQT table handles — making
+    this thread their SINGLE writer under traffic — and marks every
+    ticket done. The DECODE worker then eagerly materializes the
+    dispatched array in FIFO order (np.asarray blocks on wire +
+    compute), so by the time an owning driver demands its slice the
+    transfer is finished or already riding.
+
+    Ping-pong depth: at most ``DEPTH`` dispatches are in flight —
+    dispatch N+DEPTH stages only after dispatch N has fully
+    materialized (the semaphore), and the staging slot N % DEPTH is
+    asserted free before reuse. While dispatch N executes on device,
+    dispatch N+1's host-side pack and transport proceed concurrently
+    and dispatch N-1's results are decoding — steps/s is bounded by
+    max(transport, compute) instead of their sum.
+
+    Failure semantics are byte-for-byte the coalescer's: a flush that
+    raises fails every ticket in its batch (_execute's error path,
+    counted by fishnet_coalesce_flush_errors_total) and the error
+    reaches each owning driver at demand() time; the
+    ``service.device_step`` fault site still fires on the driver thread
+    at step time, BEFORE the microbatch is submitted. Per-thread
+    telemetry cells stay single-writer: accounting rides ticket.acct to
+    the owner, and the workers record spans only into their own rings.
+    ``FISHNET_NO_ASYNC=1`` skips building the pipeline entirely,
+    restoring the synchronous inline flush.
+    """
+
+    #: Ping-pong double buffer: two dispatches in flight, no more.
+    DEPTH = 2
+
+    def __init__(self, svc: "SearchService") -> None:
+        self._svc = svc
+        self._lock = threading.Lock()
+        self._pack_q: "queue.Queue" = queue.Queue()
+        self._decode_q: "queue.Queue" = queue.Queue()
+        self._slots = threading.Semaphore(self.DEPTH)
+        # Staging-slot occupancy (index = seq % DEPTH): the pack worker
+        # asserts a slot is free before staging into it. Releases are
+        # FIFO (the decode worker materializes in dispatch order), so
+        # the semaphore alone already guarantees this — the flags are
+        # the donation-correctness guard the async tests pin.
+        self._staging_inuse = [False] * self.DEPTH
+        self._seq = 0
+        self._stopping = False
+        self._dead: Optional[BaseException] = None
+        # Overlap accounting (lock-guarded, two transitions per
+        # dispatch, ~Hz): busy = wall time with >=1 dispatch in flight,
+        # dual = with >=2. dual/busy is the live
+        # fishnet_dispatch_overlap_ratio gauge; bench.py cross-checks
+        # it against the span flight recorder.
+        self._inflight = 0
+        self._last_ts = 0.0
+        self._busy_s = 0.0
+        self._dual_s = 0.0
+        self._pack_thread = threading.Thread(
+            target=self._pack_loop, name="dispatch-pack", daemon=True
+        )
+        self._decode_thread = threading.Thread(
+            target=self._decode_loop, name="dispatch-decode", daemon=True
+        )
+        self._pack_thread.start()
+        self._decode_thread.start()
+
+    # -- scheduling-stage API (driver threads / coalescer) ----------------
+
+    def submit(self, tickets: List[_CoalesceTicket]) -> bool:
+        """Enqueue one flush batch for the pack worker. False once the
+        pipeline is down (the coalescer then falls back to the inline
+        synchronous flush, so shutdown never strands a ticket)."""
+        with self._lock:
+            if self._stopping or self._dead is not None:
+                return False
+            seq = self._seq
+            self._seq += 1
+        self._pack_q.put((seq, tickets))
+        return True
+
+    def queue_depth(self) -> int:
+        return self._pack_q.qsize() + self._decode_q.qsize()
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def overlap_ratio(self) -> float:
+        with self._lock:
+            busy, dual = self._busy_s, self._dual_s
+        return dual / busy if busy > 0 else 0.0
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            self._stopping = True
+        self._pack_q.put(None)
+        self._pack_thread.join(timeout=timeout)
+        self._decode_q.put(None)
+        self._decode_thread.join(timeout=timeout)
+        self._fail_queued(NativeCoreError("async dispatch pipeline shut down"))
+
+    # -- worker internals --------------------------------------------------
+
+    def _mark(self, delta: int) -> None:
+        """Transition the in-flight count, integrating busy/dual time."""
+        now = time.monotonic()
+        with self._lock:
+            if self._inflight > 0:
+                dt = now - self._last_ts
+                self._busy_s += dt
+                if self._inflight > 1:
+                    self._dual_s += dt
+            self._inflight += delta
+            self._last_ts = now
+
+    def _release(self, slot: int) -> None:
+        with self._lock:
+            self._staging_inuse[slot] = False
+        self._slots.release()
+
+    def _fail_queued(self, err: BaseException) -> None:
+        """Fail every ticket still parked in either queue — demand()
+        must raise, never hang, once the workers are gone."""
+        for q in (self._pack_q, self._decode_q):
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:
+                    continue
+                for tk in item[1]:
+                    if not tk.done.is_set():
+                        tk.error = err
+                        tk.done.set()
+
+    def _pack_loop(self) -> None:
+        co = self._svc._coalescer
+        while True:
+            item = self._pack_q.get()
+            if item is None:
+                return
+            seq, tickets = item
+            self._slots.acquire()  # wait for a free ping-pong slot
+            slot = seq % self.DEPTH
+            with self._lock:
+                staging_free = not self._staging_inuse[slot]
+                self._staging_inuse[slot] = True
+            tel = _telemetry.enabled()
+            t0 = time.monotonic() if tel else 0.0
+            if not staging_free:
+                # Ping-pong invariant breach: the slot still belongs to
+                # an unmaterialized dispatch. Fail the batch loudly
+                # rather than stage over an in-flight wire.
+                err = NativeCoreError(
+                    f"staging slot {slot} reused while dispatch in flight"
+                )
+                _COALESCE_ERRORS.inc()
+                for tk in tickets:
+                    tk.error = err
+                    tk.done.set()
+                self._slots.release()
+                continue
+            try:
+                co._execute(tickets)
+            except BaseException as err:  # noqa: BLE001 - pipeline teardown
+                # _execute already failed the batch's tickets and
+                # counted the flush error; only non-Exception unwinds
+                # to here (KeyboardInterrupt and friends). Mark the
+                # pipeline dead so later flushes fall back to the
+                # drivers' inline path, then re-raise (R5).
+                self._release(slot)
+                with self._lock:
+                    self._dead = err
+                self._fail_queued(err)
+                raise
+            if tickets and tickets[0].error is not None:
+                # Exception path: _execute swallowed it after failing
+                # every owner; nothing went to the device.
+                self._release(slot)
+                continue
+            self._mark(+1)
+            if tel:
+                _SPANS.record(
+                    "dispatch_issue", t0, seq=seq, width=len(tickets),
+                    n=sum(tk.n for tk in tickets),
+                )
+            self._decode_q.put((seq, tickets))
+
+    def _decode_loop(self) -> None:
+        while True:
+            item = self._decode_q.get()
+            if item is None:
+                return
+            seq, tickets = item
+            tel = _telemetry.enabled()
+            t0 = time.monotonic() if tel else 0.0
+            try:
+                values = tickets[0].values
+                if isinstance(values, _FusedValues):
+                    values.materialize()
+                else:
+                    np.asarray(values)
+            except Exception:  # noqa: BLE001 - owners re-raise at resolve
+                # The eager warm must not kill the decode worker: the
+                # owning driver's own materialize re-raises the same
+                # device error at demand()/resolve time (counted there
+                # as a driver crash), so nothing is swallowed.
+                _COALESCE_ERRORS.inc()
+            self._mark(-1)
+            self._release(seq % self.DEPTH)
+            if tel:
+                _SPANS.record(
+                    "dispatch_wait", t0, seq=seq, width=len(tickets),
+                )
 
 
 #: Must cover the native core's largest single eval block
@@ -973,6 +1246,22 @@ class SearchService:
             self._coalescer = _DispatchCoalescer(self, pinned_width=pinned)
             if dispatch_probe is not None:
                 self._coalescer.set_probe(dispatch_probe)
+        # DOUBLE-BUFFERED ASYNC DISPATCH: pack/decode worker threads in
+        # front of the coalescer (which becomes pure scheduling) — two
+        # dispatches in flight, transport overlapped with compute.
+        # FISHNET_NO_ASYNC=1 restores the synchronous inline flush;
+        # without a coalescer there is nothing to pipeline (the per-
+        # group inflight dict already overlaps at the JAX level).
+        # FISHNET_NO_DEDUP=1 turns off cross-segment eval-dedup.
+        self._async_pipe = None
+        self._dedup_fused = (
+            os.environ.get("FISHNET_NO_DEDUP", "0") != "1"
+        )
+        if (
+            self._coalescer is not None
+            and os.environ.get("FISHNET_NO_ASYNC", "0") != "1"
+        ):
+            self._async_pipe = _AsyncDispatchPipeline(self)
         self._packed_buf = np.empty((k, 4 * cap + 4, 2, 8), dtype=np.uint16)
         self._offset_buf = np.empty((k, cap), dtype=np.int32)
         self._bucket_buf = np.empty((k, cap), dtype=np.int32)
@@ -1331,10 +1620,28 @@ class SearchService:
                 out["dispatches"] = co.dispatches
                 out["fused_dispatches"] = co.fused_dispatches
                 out["coalesced_steps"] = co.coalesced_steps
+                out["fused_dedup"] = co.deduped_evals
         else:
             out["dispatches"] = out["eval_steps"]
             out["fused_dispatches"] = 0
             out["coalesced_steps"] = 0
+            out["fused_dedup"] = 0
+        # Async-pipeline instruments (0 when synchronous): in-flight
+        # dispatch count, queue depth in front of the workers, and the
+        # busy/dual integrals behind the overlap-ratio gauge (exported
+        # in microseconds so the dict stays int-valued).
+        pipe = self._async_pipe
+        if pipe is not None:
+            out["inflight_dispatches"] = pipe.inflight()
+            out["async_ready_queue"] = pipe.queue_depth()
+            with pipe._lock:
+                out["overlap_busy_us"] = int(pipe._busy_s * 1e6)
+                out["overlap_dual_us"] = int(pipe._dual_s * 1e6)
+        else:
+            out["inflight_dispatches"] = 0
+            out["async_ready_queue"] = 0
+            out["overlap_busy_us"] = 0
+            out["overlap_dual_us"] = 0
         return out
 
     def is_alive(self) -> bool:
@@ -1381,6 +1688,11 @@ class SearchService:
         deadline = time.monotonic() + 60
         for th in self._threads:
             th.join(timeout=max(0.0, deadline - time.monotonic()))
+        # Stop the async pack/decode workers AFTER the drivers are
+        # drained: a driver blocked in demand() needs the pack worker
+        # alive to set its ticket done.
+        if self._async_pipe is not None:
+            self._async_pipe.close()
         if _telemetry.enabled():
             # Clean-close flight-recorder dump (doc/observability.md).
             _SPANS.dump(reason="close")
@@ -1561,7 +1873,41 @@ class SearchService:
             if max(tk.n for tk in tickets) <= s:
                 size = s
                 break
-        need = max(tk.rows for tk in tickets) + 4
+        # CROSS-SEGMENT EVAL-DEDUP (wire diet): identical plain-full
+        # entries across the fused dispatch's segments ship once; each
+        # duplicate is re-encoded as a one-row sentinel in-batch delta
+        # and its value restored from its original at materialize time
+        # (_FusedValues). Planned BEFORE tier selection so shrunken
+        # row streams can drop a whole tier — that, plus 3 rows saved
+        # per duplicate, is the actual byte saving. Runs before the
+        # padding writes below (the planner reads only real entries).
+        drops = refs = None
+        dups_flat = None
+        eff_rows = [tk.rows for tk in tickets]
+        if self._dedup_fused and len(tickets) > 1:
+            from fishnet_tpu.ops.ft_gather import plan_segment_dedup
+
+            drops, refs, pairs = plan_segment_dedup(
+                [self._parent_buf[tk.group] for tk in tickets],
+                [self._bucket_buf[tk.group] for tk in tickets],
+                [self._offset_buf[tk.group] for tk in tickets],
+                [tk.n for tk in tickets],
+                [self._packed_buf[tk.group] for tk in tickets],
+                None if self._material_buf is None else
+                [self._material_buf[tk.group] for tk in tickets],
+            )
+            if pairs:
+                for k, tk in enumerate(tickets):
+                    # Every dropped full shrinks its stream 4 -> 1 row.
+                    eff_rows[k] = tk.rows - 3 * len(drops[k])
+                dups_flat = [
+                    (dk * size + di, sk * size + si)
+                    for dk, di, sk, si in pairs
+                ]
+                co = self._coalescer
+                with co._lock:
+                    co.deduped_evals += len(pairs)
+        need = max(eff_rows) + 4
         tier = self._row_tiers(size)[-1]
         for rt in self._row_tiers(size):
             if need <= rt:
@@ -1580,16 +1926,51 @@ class SearchService:
             if material_cat is not None:
                 self._material_buf[g][n:size] = 0
                 material_cat[k] = self._material_buf[g][:size]
-        packed_cat = np.concatenate(
-            [self._packed_buf[tk.group][:tier] for tk in tickets]
-        )
+        seg_parents = [self._parent_buf[tk.group][:size] for tk in tickets]
+        seg_packed = [self._packed_buf[tk.group][:tier] for tk in tickets]
+        if dups_flat:
+            for k, tk in enumerate(tickets):
+                if not drops[k]:
+                    continue
+                g, n = tk.group, tk.n
+                drop_idx = np.asarray(drops[k], dtype=np.int64)
+                # Rewritten parent column: duplicates become in-batch
+                # deltas referencing their most recent preceding kept
+                # anchor (swap 0).
+                p_new = seg_parents[k].copy()
+                p_new[drop_idx] = np.asarray(refs[k], np.int32) << 1
+                seg_parents[k] = p_new
+                # Compact the row stream: kept entries keep their row
+                # spans, dropped ones collapse to one sentinel delta
+                # row (adds empty, removals empty) — garbage on device,
+                # restored on host.
+                code_old = self._parent_buf[g][:n].astype(np.int64)
+                is_delta_old = (code_old >= 0) | (
+                    (code_old <= -2) & ((((-code_old - 2) >> 1) & 1) != 0)
+                )
+                lens_new = np.where(is_delta_old, 1, 4)
+                lens_new[drop_idx] = 1
+                starts_new = np.zeros(n, np.int64)
+                np.cumsum(lens_new[:-1], out=starts_new[1:])
+                new_rows = int(starts_new[-1] + lens_new[-1])
+                off_old = self._offset_buf[g][:n].astype(np.int64)
+                pos = np.arange(new_rows, dtype=np.int64)
+                within = pos - np.repeat(starts_new, lens_new)
+                src_rows = np.repeat(off_old, lens_new) + within
+                stream = np.empty((tier, 2, 8), np.uint16)
+                stream[:new_rows] = self._packed_buf[g][src_rows]
+                stream[new_rows : new_rows + 4] = spec.NUM_FEATURES
+                stream[starts_new[drop_idx], :, :4] = spec.NUM_FEATURES
+                stream[starts_new[drop_idx], :, 4:] = (
+                    spec.DELTA_BASE + spec.NUM_FEATURES
+                )
+                seg_packed[k] = stream
+        packed_cat = np.concatenate(seg_packed)
         buckets_cat = np.concatenate(
             [self._bucket_buf[tk.group][:size] for tk in tickets]
         )
-        parents_cat = np.concatenate(
-            [self._parent_buf[tk.group][:size] for tk in tickets]
-        )
-        seg_rows = np.array([tk.rows for tk in tickets], np.int32)
+        parents_cat = np.concatenate(seg_parents)
+        seg_rows = np.array(eff_rows, np.int32)
         # Stack the groups' device-resident tables for the dispatch and
         # split them back after: device-side copies, never wire bytes —
         # the trade this layer makes to pay ONE fixed transport cost.
@@ -1607,7 +1988,7 @@ class SearchService:
         # dispatch at (size, tier), so the split is exact.
         seg_feature_bytes = tier * 2 * 8 * 2 + size * 2 * 4 + 4
         seg_material_bytes = 0 if material_cat is None else size * 4
-        shared = _FusedValues(values)
+        shared = _FusedValues(values, dups=dups_flat)
         for k, tk in enumerate(tickets):
             g = tk.group
             self._anchor_tabs[g] = new_tabs[k]

@@ -20,6 +20,13 @@ named machinery actually runs):
 * ``coalesce``    — a FUSED device dispatch: several pipeline groups'
   microbatches shipped as one segmented eval (search/service.py
   _DispatchCoalescer; fields: width, groups, n)
+* ``dispatch_issue`` — async pack worker staged + issued one device
+  dispatch (search/service.py _AsyncDispatchPipeline; fields: seq,
+  width, n). The span covers host-side pack through JAX submission.
+* ``dispatch_wait``  — async decode worker blocked materializing that
+  dispatch's values (fields: seq, width). [dispatch_issue.t,
+  dispatch_wait.t + dur] brackets one dispatch's in-flight interval;
+  bench.py's overlap-ratio report is computed from these pairs.
 
 Recording is OFF by default: every instrumentation site is gated on
 ``fishnet_tpu.telemetry.enabled()``, so with telemetry disabled the
@@ -52,7 +59,7 @@ STAGES = (
 )
 
 #: Event stages: recorded only when the named machinery runs.
-EVENT_STAGES = ("recover", "coalesce")
+EVENT_STAGES = ("recover", "coalesce", "dispatch_issue", "dispatch_wait")
 
 DEFAULT_CAPACITY = 4096  # spans kept per thread
 

@@ -52,3 +52,44 @@ class CleanService:
     async def push(self, x):
         with self._lock:
             self._queue.append(x)
+
+
+class PackDecodePipeline:
+    """The async-dispatch handoff pattern (search/service.py
+    _AsyncDispatchPipeline): a pack and a decode worker thread feed
+    each other through queues while submitters park work from async
+    context; every shared-state site is lock-guarded. Must be clean."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ready = []
+        self._inflight = 0
+        self._pack = threading.Thread(target=self._pack_loop)
+        self._decode = threading.Thread(target=self._decode_loop)
+
+    def _pack_loop(self):
+        with self._lock:
+            self._ready.pop()
+            self._inflight += 1
+
+    def _decode_loop(self):
+        with self._lock:
+            self._inflight -= 1
+
+    async def submit(self, batch):
+        with self._lock:
+            self._ready.append(batch)
+
+
+class LeakyPipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._pack = threading.Thread(target=self._pack_loop)
+
+    def _pack_loop(self):
+        self._seq += 1  # VIOLATION: unguarded vs submit's guarded bump
+
+    async def submit(self, batch):
+        with self._lock:
+            self._seq += 1

@@ -134,6 +134,7 @@ def test_r4_fires_on_known_lines():
     assert _lines(findings) == [
         ("R4", 11),  # module global from thread + async
         ("R4", 32),  # self._stopping unguarded in driver thread
+        ("R4", 91),  # LeakyPipeline._seq unguarded in pack worker
     ]
 
 
@@ -144,6 +145,18 @@ def test_r4_lock_guarded_class_is_clean():
     assert not any("CleanService" in f.message for f in findings)
     assert not any("_items" in f.message for f in findings)
     assert not any("_queue" in f.message for f in findings)
+
+
+def test_r4_pack_decode_handoff_pattern():
+    """The async-dispatch handoff (two worker threads + async
+    submitters sharing lock-guarded state) is clean; the same shape
+    with an unguarded worker-side bump is flagged."""
+    findings = check_paths(
+        [FIXTURES / "r4_cross_thread.py"], [CrossThreadStateRule()]
+    )
+    assert not any("_inflight" in f.message for f in findings)
+    assert not any("_ready" in f.message for f in findings)
+    assert any("_seq" in f.message for f in findings)
 
 
 # -- R5 -------------------------------------------------------------------

@@ -773,3 +773,28 @@ def test_persistent_codes_without_material_poison_scores_under_trace():
     vals = np.asarray(run(params, feats, buckets, parent))
     assert abs(int(vals[1])) > 10**6  # ~2^24 cp: unmistakably poisoned
     assert abs(int(vals[0])) < 10**6 and abs(int(vals[2])) < 10**6
+
+
+def test_persistent_codes_concrete_without_material_raise_structurally():
+    """The eager-path twin of the poison tests above: a CONCRETE batch
+    carrying a persistent anchor code with neither host material nor a
+    device-resolved psqt must fail structurally in the network head —
+    the in-batch-only PSQT fallback there cannot resolve table refs and
+    would otherwise return plausible garbage (jax_eval
+    _evaluate_from_acc)."""
+    from fishnet_tpu.nnue import spec as _spec
+    from fishnet_tpu.nnue.jax_eval import (
+        _evaluate_from_acc,
+        params_from_weights,
+    )
+    from fishnet_tpu.nnue.weights import NnueWeights
+
+    params = params_from_weights(NnueWeights.random(seed=5))
+    feats = jnp.asarray(
+        np.full((3, 2, _spec.MAX_ACTIVE_FEATURES), _spec.NUM_FEATURES, np.int32)
+    )
+    buckets = jnp.zeros((3,), jnp.int32)
+    parent = jnp.asarray(np.array([-1, -4, -1], np.int32))
+    acc = jnp.zeros((3, 2, _spec.L1), jnp.int32)
+    with pytest.raises(ValueError, match="persistent anchor codes"):
+        _evaluate_from_acc(params, acc, feats, buckets, parent, None)

@@ -156,6 +156,64 @@ def test_netless_pool_refuses_standard_search():
         lib.fc_pool_free(pool)
 
 
+def test_pool_provide_guard_refuses_partial_with_anchors(tmp_path):
+    """With persistent anchors enabled, fc_pool_provide must REFUSE a
+    provide shorter than the step's batch (rc -1, nothing consumed) and
+    leave the batch intact for a full retry: a partial provide would
+    re-emit blocks whose entry-0 persistent delta references an
+    anchor-table row the first emission already refreshed
+    (cpp/src/pool.cpp fc_pool_provide, ABI 8 full-provide contract)."""
+    import ctypes
+
+    import numpy as np
+
+    from fishnet_tpu.chess.board import _VARIANT_CODES
+    from fishnet_tpu.chess.core import load
+    from fishnet_tpu.protocol.types import Variant
+    from fishnet_tpu.search.service import _bind_pool_api
+
+    lib = load()
+    _bind_pool_api(lib)
+    net = str(tmp_path / "net.nnue")
+    NnueWeights.random(seed=3).save(net)
+    pool = lib.fc_pool_new(4, 1 << 20, net.encode(), 1)
+    assert pool
+    try:
+        lib.fc_pool_set_anchors(pool, 1)
+        rc = lib.fc_pool_submit(
+            pool, -1,
+            b"rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+            b"", 4000, 4, 1, 20, 0, _VARIANT_CODES[Variant.STANDARD],
+        )
+        assert rc >= 0
+        cap = 256
+        packed = np.empty((4 * cap + 4, 2, 8), np.uint16)
+        offsets = np.empty(cap, np.int32)
+        buckets = np.empty(cap, np.int32)
+        slots = np.empty(cap, np.int32)
+        parent = np.empty(cap, np.int32)
+        rows = ctypes.c_int32(0)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        n = 0
+        for _ in range(64):
+            n = lib.fc_pool_step(
+                pool, 0,
+                packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                offsets.ctypes.data_as(i32p), buckets.ctypes.data_as(i32p),
+                slots.ctypes.data_as(i32p), parent.ctypes.data_as(i32p),
+                None, cap, 0, ctypes.byref(rows),
+            )
+            if n > 0:
+                break
+        assert n > 0, "NNUE search never suspended at a leaf"
+        values = np.zeros(cap, np.int32)
+        vp = values.ctypes.data_as(i32p)
+        assert lib.fc_pool_provide(pool, 0, vp, n - 1) == -1  # refused
+        assert lib.fc_pool_provide(pool, 0, vp, n) == n  # batch intact
+    finally:
+        lib.fc_pool_free(pool)
+
+
 async def test_tiny_batch_capacity_clamped():
     """A capacity below the native core's largest eval block
     (EVAL_BLOCK_MAX=40, cpp/src/search.h:32) would livelock: emit_block is
