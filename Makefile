@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke coalesce-smoke async-smoke
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke coalesce-smoke async-smoke trace-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
@@ -45,6 +45,15 @@ coalesce-smoke:
 async-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_async_dispatch.py -q \
 		-k "xla or overlap or ping_pong or no_async_env"
+
+# Causal-tracing contract (doc/observability.md "Causal tracing",
+# ≤60 s): a gated mock-server run must yield complete span trees (zero
+# orphans), trace-context propagation across the pack/decode worker
+# handoff (fused fan-in included), a structurally valid Chrome/Perfetto
+# export, and critical-path attribution covering >=95% of steady-state
+# per-batch wall time.
+trace-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_tracing.py -q
 
 # ASan+UBSan pool stress incl. the anchor full-provide guard case —
 # the non-tier-1 `slow` job.

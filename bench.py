@@ -650,6 +650,61 @@ def overlap_report_from_spans() -> dict:
     }
 
 
+def critical_path_report_from_spans(fixed_transport_ms=None) -> dict:
+    """Critical-path attribution over the flight recorder's causal
+    spans (telemetry/critical_path.py): mean steady-state per-batch
+    wall time split into queue_wait/pack/transport/compute/decode_wait/
+    submit, with ``coverage`` = the attributed (non-``other``)
+    fraction — the acceptance bar is >= 0.95 on a gated run."""
+    from fishnet_tpu.telemetry import critical_path as _cp
+    from fishnet_tpu.telemetry.spans import RECORDER
+
+    return _cp.report(
+        RECORDER.spans(), fixed_transport_ms=fixed_transport_ms
+    )
+
+
+#: The bench summary contract: every key a driver parsing the single
+#: stdout JSON line (or --json-out) may rely on. Nested tuples pin the
+#: sub-dicts produced by overlap_report_from_spans() and
+#: critical_path_report_from_spans(). tests/test_tracing.py pins this
+#: schema; extend it when adding summary fields (additive only).
+SUMMARY_SCHEMA = {
+    "top": (
+        "metric", "value", "unit", "vs_baseline", "psqt_path",
+        "dispatches_per_step", "coalesce_width_avg",
+        "dispatch_overlap_ratio", "critical_path", "transport", "device",
+        "host", "az", "frc", "traffic", "search_quality",
+    ),
+    "traffic.overlap": (
+        "dispatches_paired", "busy_s", "dual_s", "overlap_ratio",
+    ),
+    "critical_path": (
+        "queue_wait_ms", "pack_ms", "transport_ms", "compute_ms",
+        "decode_wait_ms", "submit_ms", "other_ms", "wall_ms", "coverage",
+        "traces",
+    ),
+}
+
+
+def validate_summary(summary: dict) -> None:
+    """Raise ``ValueError`` if ``summary`` is missing any key the
+    emitted-JSON contract (SUMMARY_SCHEMA) promises."""
+    missing = [k for k in SUMMARY_SCHEMA["top"] if k not in summary]
+    overlap = summary.get("traffic", {}).get("overlap", {})
+    missing += [
+        f"traffic.overlap.{k}"
+        for k in SUMMARY_SCHEMA["traffic.overlap"] if k not in overlap
+    ]
+    cp = summary.get("critical_path", {})
+    missing += [
+        f"critical_path.{k}"
+        for k in SUMMARY_SCHEMA["critical_path"] if k not in cp
+    ]
+    if missing:
+        raise ValueError(f"bench summary missing keys: {missing}")
+
+
 def bench_search_quality() -> dict:
     """Search QUALITY (depth at node budget) — a property of the search
     tree, not of the transport: the scalar backend walks the same tree
@@ -865,6 +920,7 @@ def emit_summary(summary: dict, json_out: str) -> None:
     artifact a driver should prefer), then — after flushing stderr so
     no progress line can interleave — printed as exactly one final
     flush-terminated line on stdout."""
+    validate_summary(summary)
     line = json.dumps(summary)
     if json_out:
         try:
@@ -1141,6 +1197,15 @@ def main(argv=None) -> None:
     # amply covering the e2e tier's dispatch count).
     traffic["overlap"] = overlap_report_from_spans()
     log(f"bench: dispatch overlap (spans): {traffic['overlap']}")
+    # Critical-path attribution from the same causal spans: mean
+    # steady-state per-batch wall time broken into queue_wait / pack /
+    # transport / compute / decode_wait / submit. The small-batch RTT
+    # probe calibrates the fixed-transport share of the in-flight
+    # interval (payload-independent tunnel cost).
+    critical_path = critical_path_report_from_spans(
+        fixed_transport_ms=transport.get("rtt_ms_256")
+    )
+    log(f"bench: critical path (spans): {critical_path}")
 
     if captured:
         log("bench: device throughput at the realized e2e batch mix...")
@@ -1185,6 +1250,9 @@ def main(argv=None) -> None:
             # Async double-buffering headline: span-proven fraction of
             # dispatch-busy time with a second dispatch in flight.
             "dispatch_overlap_ratio": traffic["overlap"]["overlap_ratio"],
+            # Causal-trace attribution (telemetry/critical_path.py):
+            # where a steady-state batch's wall time actually went.
+            "critical_path": critical_path,
             "transport": transport,
             "device": device,
             "host": host,

@@ -275,6 +275,12 @@ async def run_client(opt: Opt, logger: Logger) -> None:
     # Live telemetry (opt-in via --metrics-port / MetricsPort ini key):
     # /metrics + /json on an http.server thread, span recording in the
     # pipeline hot paths, SIGUSR2 armed to dump the flight recorder.
+    # --spans-dir / SpansDir steers where the flight recorder dumps its
+    # fishnet-spans-<pid>.jsonl (spans.default_path reads the env var;
+    # exporting keeps engine subprocesses consistent with this process).
+    if opt.spans_dir is not None:
+        _os.environ["FISHNET_SPANS_DIR"] = opt.spans_dir
+
     exporter = None
     if opt.metrics_port is not None:
         from fishnet_tpu import telemetry

@@ -207,6 +207,12 @@ class Opt:
     #: off (the default; hot paths pay one flag check); 0 = an ephemeral
     #: port (logged at startup); otherwise the port /metrics binds on.
     metrics_port: Optional[int] = None
+    #: Directory for span flight-recorder JSONL dumps
+    #: (doc/observability.md). None = the ``FISHNET_SPANS_DIR`` /
+    #: ``FISHNET_SPANS_FILE`` environment, falling back to a
+    #: ``fishnet-spans/`` directory under the system tempdir — never
+    #: the process working directory.
+    spans_dir: Optional[str] = None
     #: Deterministic fault plan (doc/resilience.md grammar). None =
     #: fault injection off (the default; sites pay one flag check).
     #: ``FISHNET_FAULT_PLAN`` in the environment is the fallback for
@@ -315,6 +321,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "/json snapshot) on this port and arm the SIGUSR2 "
                         "span-dump. 0 picks an ephemeral port. Default: "
                         "telemetry off.")
+    p.add_argument("--spans-dir", default=None,
+                   help="Directory for span flight-recorder JSONL dumps "
+                        "(fishnet-spans-<pid>.jsonl). Default: "
+                        "$FISHNET_SPANS_DIR, else <tempdir>/fishnet-spans.")
     p.add_argument("--fault-plan", default=None,
                    help="Deterministic fault plan (doc/resilience.md "
                         "grammar), e.g. 'seed=7;net.acquire:nth=2:error'. "
@@ -375,6 +385,8 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
         opt.mesh = parse_mesh(ns.mesh)
     if ns.metrics_port is not None:
         opt.metrics_port = _parse_port(str(ns.metrics_port))
+    if ns.spans_dir is not None:
+        opt.spans_dir = ns.spans_dir
     if ns.fault_plan is not None:
         opt.fault_plan = _parse_fault_plan(ns.fault_plan)
     if ns.batch_deadline is not None:
@@ -428,6 +440,7 @@ _INI_FIELDS = (
     ("SearchConcurrency", "search_concurrency",
      lambda v: _positive_int(v, "SearchConcurrency")),
     ("MetricsPort", "metrics_port", lambda v: _parse_port(v)),
+    ("SpansDir", "spans_dir", str),
     ("FaultPlan", "fault_plan", lambda v: _parse_fault_plan(v)),
     ("BatchDeadline", "batch_deadline", parse_duration),
 )

@@ -25,6 +25,7 @@ from fishnet_tpu import telemetry as _telemetry
 from fishnet_tpu.resilience import accounting as _accounting
 from fishnet_tpu.resilience import faults as _faults
 from fishnet_tpu.resilience.supervisor import CircuitBreaker
+from fishnet_tpu.telemetry import tracing as _tracing
 from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 from fishnet_tpu.protocol.types import (
     Acquired,
@@ -354,7 +355,33 @@ class ApiActor:
             )
             _REQUESTS.inc(endpoint=msg.kind, outcome="ok")
             if msg.kind == "acquire" and _telemetry.enabled():
-                _SPANS.record("acquire", started)
+                # Batch-trace ROOT: _parse_acquired stashed the batch id
+                # on the message, and batch_root derives deterministic
+                # ids from it — so schedule (sched/queue.py) and the
+                # final submit below parent into the same tree with no
+                # shared registry. An empty acquire stays traceless.
+                if msg.batch_id:
+                    _SPANS.record(
+                        "acquire", started,
+                        trace=_tracing.batch_root(msg.batch_id),
+                        batch=msg.batch_id,
+                    )
+                else:
+                    _SPANS.record("acquire", started)
+            if (
+                msg.kind == "submit_analysis"
+                and msg.final
+                and msg.batch_id
+                and _telemetry.enabled()
+            ):
+                # The batch trace's terminal span: the completed
+                # analysis' submission round-trip, child of the
+                # deterministic acquire root.
+                _SPANS.record(
+                    "submit", started,
+                    trace=_tracing.batch_child(msg.batch_id),
+                    batch=msg.batch_id,
+                )
             if msg.kind == "submit_analysis" and self.breaker.record_success():
                 self.logger.info("Submit circuit breaker closed; draining.")
                 self._drain_parked()
@@ -432,6 +459,11 @@ class ApiActor:
             led = _accounting.get()
             if led is not None:
                 led.record_acquired(body.work.id)
+            if msg.kind == "acquire":
+                # Feed the acquire span's batch trace root (_handle):
+                # move submissions keep THEIR batch id — the chained
+                # acquire's new batch must not clobber retry accounting.
+                msg.batch_id = body.work.id
             if not self._fulfil(msg, Acquired.accepted(body)):
                 # Nobody is waiting for this job anymore: abort so the
                 # server can reassign immediately (api.rs:678-684).

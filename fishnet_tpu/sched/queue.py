@@ -33,6 +33,7 @@ from fishnet_tpu import telemetry as _telemetry
 from fishnet_tpu.chess import Board, InvalidFenError, UnsupportedVariantError
 from fishnet_tpu.resilience import accounting as _accounting
 from fishnet_tpu.resilience import faults as _faults
+from fishnet_tpu.telemetry import tracing as _tracing
 from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 from fishnet_tpu.ipc import Position, PositionFailed, PositionResponse
 from fishnet_tpu.net.api import ApiStub
@@ -204,6 +205,10 @@ class PendingBatch:
     sources: List[object] = field(default_factory=list)
     #: Requeue generation (bounded by MAX_REQUEUE_GENERATIONS).
     generation: int = 0
+    #: The batch's ``schedule`` trace context (telemetry/tracing.py),
+    #: set when telemetry is on: ``queue_wait`` spans recorded at
+    #: worker-pull time chain under it. None with telemetry off.
+    trace: Optional[object] = None
 
     def pending(self) -> int:
         return sum(1 for p in self.positions if p is None)
@@ -415,6 +420,19 @@ class QueueState:
         while self.incoming:
             position = self.incoming.popleft()
             if not callback.done():
+                if _telemetry.enabled():
+                    # "queue_wait" span: this position's dwell in the
+                    # incoming queue, from batch enqueue to this pull —
+                    # a child of the batch's schedule span (the context
+                    # stashed on PendingBatch at accept time).
+                    batch = self.pending.get(position.work.id)
+                    if batch is not None and batch.trace is not None:
+                        _SPANS.record(
+                            "queue_wait", batch.started_at,
+                            trace=batch.trace.child(),
+                            batch=position.work.id,
+                            position_id=position.position_id,
+                        )
                 callback.set_result(position)
                 return True
             # Callback abandoned (worker gone): keep the position.
@@ -422,7 +440,9 @@ class QueueState:
             return True
         return False
 
-    def add_incoming_batch(self, batch: IncomingBatch) -> None:
+    def add_incoming_batch(
+        self, batch: IncomingBatch, trace: Optional[object] = None
+    ) -> None:
         batch_id = batch.work.id
         if batch_id in self.pending:
             self.logger.error(f"Dropping duplicate incoming batch {batch_id}")
@@ -442,6 +462,7 @@ class QueueState:
             started_at=time.monotonic(),
             url=batch.url,
             sources=list(batch.positions),
+            trace=trace,
         )
         led = _accounting.get()
         if led is not None:
@@ -702,8 +723,12 @@ class QueueActor:
         context = body.work.id
         # "schedule" span: trust-boundary replay + per-ply expansion +
         # enqueue — the stage between acquire and the search pipeline.
+        # Its trace context parents into the batch trace by digest
+        # (tracing.batch_child: the acquire root's span id IS the
+        # deterministic trace id, no cross-actor plumbing needed).
         tel = _telemetry.enabled()
         t0 = time.monotonic() if tel else 0.0
+        sched_ctx = _tracing.batch_child(context) if tel else None
         try:
             # "queue.schedule" fault site: a failure here is a
             # trust-boundary failure — the batch is dropped like an
@@ -725,7 +750,8 @@ class QueueActor:
             )
             if tel:
                 _SPANS.record(
-                    "schedule", t0, batch=context, outcome="all_skipped"
+                    "schedule", t0, trace=sched_ctx,
+                    batch=context, outcome="all_skipped",
                 )
             return
         except (IncomingError, _faults.FaultInjected) as err:
@@ -734,12 +760,16 @@ class QueueActor:
             if led is not None:
                 led.record_invalid(context, str(err))
             if tel:
-                _SPANS.record("schedule", t0, batch=context, outcome="invalid")
+                _SPANS.record(
+                    "schedule", t0, trace=sched_ctx,
+                    batch=context, outcome="invalid",
+                )
             return
-        self.state.add_incoming_batch(incoming)
+        self.state.add_incoming_batch(incoming, trace=sched_ctx)
         if tel:
             _SPANS.record(
-                "schedule", t0, batch=context, outcome="accepted",
+                "schedule", t0, trace=sched_ctx,
+                batch=context, outcome="accepted",
                 positions=len(incoming.positions),
             )
 

@@ -41,6 +41,7 @@ from fishnet_tpu.chess.core import NativeCoreError, load
 from fishnet_tpu.protocol.types import Variant
 from fishnet_tpu.nnue import spec
 from fishnet_tpu.nnue.weights import NnueWeights
+from fishnet_tpu.telemetry import tracing as _tracing
 from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 
 
@@ -347,6 +348,11 @@ _COUNTER_METRICS = {
     "async_ready_queue": ("fishnet_dispatch_ready_queue_depth", "gauge",
                           "Flush batches queued in front of the async "
                           "pack/decode workers."),
+    "decode_queue": ("fishnet_decode_queue_depth", "gauge",
+                     "Issued dispatches queued behind the decode worker "
+                     "(output-side backlog; pair with "
+                     "fishnet_dispatch_ready_queue_depth on the input "
+                     "side)."),
 }
 
 
@@ -484,14 +490,21 @@ class _CoalesceTicket:
     flushing thread after ``values``/``acct`` (or ``error``) are
     assigned — the Event provides the cross-thread ordering. After a
     FUSED dispatch ``values`` is a ``_FusedValues`` holder and
-    ``start``/``seg_size`` locate this segment's slice."""
+    ``start``/``seg_size`` locate this segment's slice.
+
+    ``trace`` carries the owning driver's ``device_step`` trace context
+    across the coalescer's thread handoffs (doc/observability.md): the
+    pack and decode workers parent their shared dispatch spans under it
+    — context travels on the ticket, never thread-local."""
 
     __slots__ = (
         "group", "n", "rows", "values", "start", "seg_size", "acct",
-        "error", "done",
+        "error", "done", "trace",
     )
 
-    def __init__(self, group: int, n: int, rows: int) -> None:
+    def __init__(
+        self, group: int, n: int, rows: int, trace=None
+    ) -> None:
         self.group = group
         self.n = n
         self.rows = rows
@@ -501,6 +514,7 @@ class _CoalesceTicket:
         self.acct = None
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
+        self.trace = trace
 
 
 class _DispatchCoalescer:
@@ -591,10 +605,15 @@ class _DispatchCoalescer:
         else:
             self._linger_s = 0.0
 
-    def submit(self, group: int, n: int, rows: int) -> _CoalesceTicket:
+    def submit(
+        self, group: int, n: int, rows: int, trace=None
+    ) -> _CoalesceTicket:
         """Park a stepped group's microbatch; returns its ticket. May
-        flush (dispatch) on this thread if the policy width is reached."""
-        ticket = _CoalesceTicket(group, n, rows)
+        flush (dispatch) on this thread if the policy width is reached.
+        ``trace`` (the owner's device_step context) must ride the
+        ticket from birth — the width trigger can flush inline before
+        the caller ever sees the ticket."""
+        ticket = _CoalesceTicket(group, n, rows, trace=trace)
         flush = None
         with self._lock:
             ema = self._occ_ema
@@ -678,8 +697,15 @@ class _DispatchCoalescer:
         for tk in tickets:
             tk.done.set()
         if tel and len(tickets) > 1:
+            # Fan-in span: one fused dispatch belongs to every segment
+            # owner's step trace — parent under the first owner, link
+            # the rest (the critical-path analyzer re-attaches it).
+            ctxs = [tk.trace for tk in tickets if tk.trace is not None]
             _SPANS.record(
-                "coalesce", t0, width=len(tickets),
+                "coalesce", t0,
+                trace=ctxs[0].child() if ctxs else None,
+                links=_tracing.links_for(ctxs[1:]) or None,
+                width=len(tickets),
                 groups=[tk.group for tk in tickets],
                 n=sum(tk.n for tk in tickets),
             )
@@ -774,6 +800,13 @@ class _AsyncDispatchPipeline:
 
     def queue_depth(self) -> int:
         return self._pack_q.qsize() + self._decode_q.qsize()
+
+    def decode_queue_depth(self) -> int:
+        """Issued dispatches queued behind the decode worker — the
+        OUTPUT-side backlog (the input side is the ready queue above).
+        Persistently > 0 means materialization, not staging, is the
+        pipeline's slow stage."""
+        return self._decode_q.qsize()
 
     def inflight(self) -> int:
         with self._lock:
@@ -874,19 +907,32 @@ class _AsyncDispatchPipeline:
                 self._release(slot)
                 continue
             self._mark(+1)
+            issue_ctx = None
+            links = None
             if tel:
+                # The shared dispatch span fans into every owner's step
+                # trace: parent under the first ticket's device_step
+                # context, link the rest (tracing.py convention). The
+                # context then rides the decode-queue item so the
+                # decode worker's dispatch_wait chains under it —
+                # surviving the second thread handoff.
+                ctxs = [tk.trace for tk in tickets if tk.trace is not None]
+                if ctxs:
+                    issue_ctx = ctxs[0].child()
+                    links = _tracing.links_for(ctxs[1:]) or None
                 _SPANS.record(
-                    "dispatch_issue", t0, seq=seq, width=len(tickets),
+                    "dispatch_issue", t0, trace=issue_ctx, links=links,
+                    seq=seq, width=len(tickets),
                     n=sum(tk.n for tk in tickets),
                 )
-            self._decode_q.put((seq, tickets))
+            self._decode_q.put((seq, tickets, issue_ctx, links))
 
     def _decode_loop(self) -> None:
         while True:
             item = self._decode_q.get()
             if item is None:
                 return
-            seq, tickets = item
+            seq, tickets, issue_ctx, links = item
             tel = _telemetry.enabled()
             t0 = time.monotonic() if tel else 0.0
             try:
@@ -905,7 +951,9 @@ class _AsyncDispatchPipeline:
             self._release(seq % self.DEPTH)
             if tel:
                 _SPANS.record(
-                    "dispatch_wait", t0, seq=seq, width=len(tickets),
+                    "dispatch_wait", t0,
+                    trace=issue_ctx.child() if issue_ctx else None,
+                    links=links, seq=seq, width=len(tickets),
                 )
 
 
@@ -1634,12 +1682,14 @@ class SearchService:
         if pipe is not None:
             out["inflight_dispatches"] = pipe.inflight()
             out["async_ready_queue"] = pipe.queue_depth()
+            out["decode_queue"] = pipe.decode_queue_depth()
             with pipe._lock:
                 out["overlap_busy_us"] = int(pipe._busy_s * 1e6)
                 out["overlap_dual_us"] = int(pipe._dual_s * 1e6)
         else:
             out["inflight_dispatches"] = 0
             out["async_ready_queue"] = 0
+            out["decode_queue"] = 0
             out["overlap_busy_us"] = 0
             out["overlap_dual_us"] = 0
         return out
@@ -2069,14 +2119,15 @@ class SearchService:
             )
             for g in groups
         }
-        # In-flight device evals per group: group -> (n, dispatched array).
+        # In-flight device evals per group: group -> (n, dispatched
+        # array or ticket, device_step trace context or None).
         # The software pipeline: resolve group g's previous eval (blocks
         # only on the oldest dispatch), wake its fibers, step them to new
         # leaves, dispatch the next eval — then move to group g+1 while
         # this one rides the host<->device link. With k groups per thread
         # up to k batches overlap CPU search, transfer, and device
         # compute — and T threads' CPU phases overlap each other.
-        inflight: Dict[int, Tuple[int, object]] = {}
+        inflight: Dict[int, Tuple[int, object, object]] = {}
 
         # Compile every eval-size bucket up front (first thread compiles,
         # the rest block on the shared warmup lock): a first-touch XLA
@@ -2160,7 +2211,7 @@ class SearchService:
             stepped = 0
             for g in groups:
                 if g in inflight:
-                    n_prev, handle = inflight.pop(g)
+                    n_prev, handle, dctx = inflight.pop(g)
                     t0 = time.monotonic() if tel else 0.0
                     if isinstance(handle, _CoalesceTicket):
                         # Flushes the coalescer if this ticket is still
@@ -2173,7 +2224,11 @@ class SearchService:
                         arr = handle
                     values = self._resolve_eval(n_prev, arr)
                     if tel:
-                        _SPANS.record("wire_decode", t0, group=g, n=n_prev)
+                        _SPANS.record(
+                            "wire_decode", t0,
+                            trace=dctx.child() if dctx else None,
+                            group=g, n=n_prev,
+                        )
                         t0 = time.monotonic()
                     rc = lib.fc_pool_provide(
                         self._pool, g,
@@ -2182,7 +2237,9 @@ class SearchService:
                     )
                     if tel:
                         _SPANS.record(
-                            "postprocess", t0, group=g, n=n_prev, op="provide"
+                            "postprocess", t0,
+                            trace=dctx.child() if dctx else None,
+                            group=g, n=n_prev, op="provide",
                         )
                     if rc < 0:
                         # The pool refused a partial provide (anchors
@@ -2201,8 +2258,16 @@ class SearchService:
                     parent_ptrs[g], material_ptrs[g], self._group_capacity,
                     self._shard_align, ctypes.byref(rows),
                 )
+                # Step-trace root: each eval microbatch gets a fresh
+                # trace at pack time; device_step chains under it and
+                # the context rides the coalesce ticket across the
+                # pack/decode worker handoffs (doc/observability.md).
+                step_ctx = _tracing.new_trace() if tel and n > 0 else None
                 if tel:
-                    _SPANS.record("pack", t0, group=g, n=n, rows=rows.value)
+                    _SPANS.record(
+                        "pack", t0, trace=step_ctx,
+                        group=g, n=n, rows=rows.value,
+                    )
                 stepped += n
                 if n > 0:
                     if self._eval_fn is None:
@@ -2214,19 +2279,26 @@ class SearchService:
                     if _faults.enabled():
                         _faults.fire("service.device_step")
                     t0 = time.monotonic() if tel else 0.0
+                    dctx = step_ctx.child() if step_ctx is not None else None
                     if self._coalescer is not None:
                         # Park the microbatch with the coalescer; it
                         # dispatches fused with other ready groups (or
                         # solo) by the time its ticket is demanded.
                         inflight[g] = (
-                            n, self._coalescer.submit(g, n, rows.value)
+                            n,
+                            self._coalescer.submit(
+                                g, n, rows.value, trace=dctx
+                            ),
+                            dctx,
                         )
                     else:
                         values, acct = self._dispatch_eval(g, n, rows.value)
                         self._apply_acct(t, acct)
-                        inflight[g] = (n, values)
+                        inflight[g] = (n, values, dctx)
                     if tel:
-                        _SPANS.record("device_step", t0, group=g, n=n)
+                        _SPANS.record(
+                            "device_step", t0, trace=dctx, group=g, n=n
+                        )
 
             # Harvest this thread's finished searches.
             for g in groups:

@@ -37,10 +37,19 @@ from fishnet_tpu.telemetry.registry import (  # noqa: F401 - public API
     gauge_family,
 )
 from fishnet_tpu.telemetry.spans import (  # noqa: F401 - public API
+    EVENT_STAGES,
     RECORDER,
     STAGES,
     SpanRecorder,
     install_signal_dump,
+)
+from fishnet_tpu.telemetry.tracing import (  # noqa: F401 - public API
+    TraceContext,
+    batch_child,
+    batch_root,
+    links_for,
+    new_trace,
+    trace_id_for_batch,
 )
 
 _enabled = False

@@ -13,6 +13,8 @@ Endpoints:
 * ``GET /metrics`` — Prometheus text exposition (version 0.0.4)
 * ``GET /json``    — JSON snapshot of the same families
 * ``GET /spans``   — current flight-recorder contents as JSON
+* ``GET /trace``   — same contents as a Chrome/Perfetto trace (load
+  the response body at https://ui.perfetto.dev)
 * ``GET /healthz`` — liveness probe (``ok``)
 """
 
@@ -89,6 +91,14 @@ def _make_handler(registry: MetricsRegistry):
                     from fishnet_tpu.telemetry.spans import RECORDER
 
                     body = json.dumps({"spans": RECORDER.spans()}).encode()
+                    self._send(200, "application/json", body)
+                elif path == "/trace":
+                    from fishnet_tpu.telemetry.spans import RECORDER
+                    from fishnet_tpu.telemetry.trace_export import (
+                        chrome_trace,
+                    )
+
+                    body = json.dumps(chrome_trace(RECORDER.spans())).encode()
                     self._send(200, "application/json", body)
                 elif path == "/healthz":
                     self._send(200, "text/plain", b"ok\n")

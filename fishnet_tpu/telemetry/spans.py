@@ -27,6 +27,11 @@ named machinery actually runs):
   dispatch's values (fields: seq, width). [dispatch_issue.t,
   dispatch_wait.t + dur] brackets one dispatch's in-flight interval;
   bench.py's overlap-ratio report is computed from these pairs.
+* ``queue_wait``  — one position's dwell in the scheduler's incoming
+  queue, from batch enqueue to worker pull (sched/queue.py; fields:
+  batch, position_id)
+* ``submit``      — the final analysis submission round-trip for a
+  completed batch (net/api.py; fields: batch)
 
 Recording is OFF by default: every instrumentation site is gated on
 ``fishnet_tpu.telemetry.enabled()``, so with telemetry disabled the
@@ -35,13 +40,24 @@ rings stay empty. When enabled, ``record()`` is one ``time.monotonic()``
 call plus a slot store into a preallocated per-thread ring — no lock,
 single writer per ring.
 
-Dumps append to ``FISHNET_SPANS_FILE`` (default
-``fishnet-spans-<pid>.jsonl`` in the working directory), one header
-object per dump then one object per span. They fire on SIGUSR2 (when
-installed via :func:`install_signal_dump`), on ``SearchService``
-driver-crash teardown (``_fail_all``), and on clean service close. Rings
-are not cleared by a dump, so successive dumps overlap — dedupe on the
-``seq`` field if that matters to a consumer.
+Causal tracing (``fishnet-spans/2``, additive): ``record()`` optionally
+takes a :class:`fishnet_tpu.telemetry.tracing.TraceContext`, adding
+``trace_id``/``span_id``/``parent_id`` fields to the flat record, plus
+``links`` — a list of ``(trace_id, span_id)`` pairs naming the OTHER
+owners of a shared fan-in span (one fused dispatch serving K segment
+traces). Consumers that only know ``fishnet-spans/1`` still parse every
+line: the flat shape is unchanged, the fields are extra.
+
+Dump location: ``FISHNET_SPANS_FILE`` names the exact file when set;
+otherwise dumps land as ``fishnet-spans-<pid>.jsonl`` inside
+``FISHNET_SPANS_DIR`` (``--spans-dir``), defaulting to a
+``fishnet-spans/`` directory under the system tempdir — never the
+process CWD. One header object per dump then one object per span.
+Dumps fire on SIGUSR2 (when installed via :func:`install_signal_dump`),
+on ``SearchService`` driver-crash teardown (``_fail_all``), and on
+clean service close. Rings are not cleared by a dump, so successive
+dumps overlap — dedupe on the ``seq`` field if that matters to a
+consumer.
 """
 
 from __future__ import annotations
@@ -59,7 +75,14 @@ STAGES = (
 )
 
 #: Event stages: recorded only when the named machinery runs.
-EVENT_STAGES = ("recover", "coalesce", "dispatch_issue", "dispatch_wait")
+EVENT_STAGES = (
+    "recover", "coalesce", "dispatch_issue", "dispatch_wait",
+    "queue_wait", "submit",
+)
+
+#: Span-dump header format. /2 added the additive causal-trace fields
+#: (trace_id/span_id/parent_id/links) — /1 consumers parse it unchanged.
+FORMAT = "fishnet-spans/2"
 
 DEFAULT_CAPACITY = 4096  # spans kept per thread
 
@@ -106,15 +129,33 @@ class SpanRecorder:
 
     # -- hot path ---------------------------------------------------------
 
-    def record(self, stage: str, started: float, **fields) -> None:
+    def record(
+        self,
+        stage: str,
+        started: float,
+        trace=None,
+        links=None,
+        **fields,
+    ) -> None:
         """Record a span that began at monotonic time ``started`` and
-        ends now. Call sites gate on ``telemetry.enabled()``."""
+        ends now. Call sites gate on ``telemetry.enabled()``.
+
+        ``trace`` (a tracing.TraceContext) adds the causal-tree fields;
+        ``links`` adds the shared-span fan-in list — both additive on
+        the flat record (fishnet-spans/2)."""
         ring = getattr(self._local, "ring", None)
         if ring is None:
             ring = _Ring(self._capacity, threading.current_thread().name)
             with self._lock:
                 self._rings.append(ring)
             self._local.ring = ring
+        if trace is not None:
+            fields["trace_id"] = trace.trace_id
+            fields["span_id"] = trace.span_id
+            if trace.parent_id is not None:
+                fields["parent_id"] = trace.parent_id
+        if links:
+            fields["links"] = [list(lk) for lk in links]
         ring.append((stage, started, time.monotonic() - started, fields))
 
     # -- dumping ----------------------------------------------------------
@@ -142,9 +183,20 @@ class SpanRecorder:
         return {r["stage"] for r in self.spans()}
 
     def default_path(self) -> str:
-        return os.environ.get(
-            "FISHNET_SPANS_FILE", f"fishnet-spans-{os.getpid()}.jsonl"
+        """Where dumps land: ``FISHNET_SPANS_FILE`` wins outright;
+        otherwise ``fishnet-spans-<pid>.jsonl`` inside
+        ``FISHNET_SPANS_DIR`` or, unset, a ``fishnet-spans/`` directory
+        under the system tempdir — never the process CWD (nine stray
+        root dumps taught that lesson)."""
+        explicit = os.environ.get("FISHNET_SPANS_FILE")
+        if explicit:
+            return explicit
+        import tempfile
+
+        base = os.environ.get("FISHNET_SPANS_DIR") or os.path.join(
+            tempfile.gettempdir(), "fishnet-spans"
         )
+        return os.path.join(base, f"fishnet-spans-{os.getpid()}.jsonl")
 
     def dump(self, path: Optional[str] = None, reason: str = "manual") -> str:
         """Append one header line + all spans (JSONL) to ``path``;
@@ -156,7 +208,7 @@ class SpanRecorder:
             self._seq += 1
             seq = self._seq
         header = {
-            "format": "fishnet-spans/1",
+            "format": FORMAT,
             "seq": seq,
             "reason": reason,
             "pid": os.getpid(),
@@ -165,6 +217,9 @@ class SpanRecorder:
             "spans": len(spans),
         }
         try:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
             with open(path, "a") as fp:
                 fp.write(json.dumps(header) + "\n")
                 for rec in spans:

@@ -294,7 +294,7 @@ def test_dump_jsonl_format(tmp_path):
     rec.dump(str(path), reason="test")
     lines = [json.loads(l) for l in path.read_text().splitlines()]
     header, spans = lines[0], lines[1:]
-    assert header["format"] == "fishnet-spans/1"
+    assert header["format"] == "fishnet-spans/2"
     assert header["reason"] == "test"
     assert header["spans"] == len(spans) == len(STAGES)
     assert {s["stage"] for s in spans} == set(STAGES)
