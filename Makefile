@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke coalesce-smoke async-smoke trace-smoke
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
@@ -24,6 +24,15 @@ metrics-smoke:
 # on /metrics.
 soak-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_soak.py -q
+
+# Overload-serving contract (doc/resilience.md "Admission control and
+# load shedding", ≤60 s): the multi-tenant lane scheduler + shed
+# policy units, shutdown/requeue/deadline accounting under concurrent
+# tenants, the /healthz serving state, and a small saturation bench
+# run — analysis sheds at the watermark, best-move p99 holds, the
+# queue stays bounded, and the ledger is exactly-once throughout.
+overload-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_overload.py -q
 
 # Coalesced-dispatch contract (doc/wire-format.md "Segmented
 # dispatch"): segmented-vs-per-group bit parity on all three psqt_path

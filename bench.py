@@ -684,12 +684,44 @@ SUMMARY_SCHEMA = {
         "decode_wait_ms", "submit_ms", "other_ms", "wall_ms", "coverage",
         "traces",
     ),
+    # --overload mode emits a DIFFERENT summary (keyed by mode ==
+    # "overload"): saturation-serving percentiles instead of throughput
+    # tiers. Additive: legacy summaries have no "mode" key and are
+    # validated against "top" exactly as before.
+    "overload": (
+        "metric", "value", "unit", "mode", "tenants", "seconds",
+        "latency", "shedding", "fairness", "queue", "ledger", "server",
+    ),
+    "overload.latency": (
+        "move_p50_ms", "move_p99_ms", "move_n", "move_p99_budget_ms",
+        "move_within_budget", "analysis_first_p50_ms",
+        "analysis_first_p99_ms", "analysis_n",
+    ),
+    "overload.queue": (
+        "max_latency_depth", "max_throughput_depth", "depth_bound",
+        "bounded", "samples",
+    ),
 }
 
 
 def validate_summary(summary: dict) -> None:
     """Raise ``ValueError`` if ``summary`` is missing any key the
     emitted-JSON contract (SUMMARY_SCHEMA) promises."""
+    if summary.get("mode") == "overload":
+        missing = [k for k in SUMMARY_SCHEMA["overload"] if k not in summary]
+        lat = summary.get("latency", {})
+        missing += [
+            f"latency.{k}"
+            for k in SUMMARY_SCHEMA["overload.latency"] if k not in lat
+        ]
+        q = summary.get("queue", {})
+        missing += [
+            f"queue.{k}"
+            for k in SUMMARY_SCHEMA["overload.queue"] if k not in q
+        ]
+        if missing:
+            raise ValueError(f"bench summary missing keys: {missing}")
+        return
     missing = [k for k in SUMMARY_SCHEMA["top"] if k not in summary]
     overlap = summary.get("traffic", {}).get("overlap", {})
     missing += [
@@ -703,6 +735,185 @@ def validate_summary(summary: dict) -> None:
     ]
     if missing:
         raise ValueError(f"bench summary missing keys: {missing}")
+
+
+def _percentile(values, q: float):
+    """Nearest-rank percentile (q in [0, 100]); None on no samples."""
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, round(q / 100.0 * (len(vs) - 1))))
+    return vs[idx]
+
+
+#: Overload-mode knobs (all overridable by flag or env).
+OVERLOAD_SECONDS = float(_os.environ.get("FISHNET_OVERLOAD_SECONDS", 12.0))
+OVERLOAD_TENANTS = int(_os.environ.get("FISHNET_OVERLOAD_TENANTS", 4))
+#: Saturation factor: the fake server keeps ``factor x tenants x 2``
+#: unacquired jobs queued at all times — the client can never drain it.
+OVERLOAD_SATURATION = int(_os.environ.get("FISHNET_OVERLOAD_SATURATION", 4))
+#: Throughput-lane admission high watermark (positions) for the run.
+OVERLOAD_WATERMARK = int(_os.environ.get("FISHNET_OVERLOAD_WATERMARK", 24))
+#: Best-move-lane p99 budget under saturation. The latency lane is
+#: strict-priority over analysis and its jobs are single positions, so
+#: even a saturated queue should clear a move in well under this; the
+#: overload smoke asserts it.
+OVERLOAD_MOVE_P99_BUDGET_MS = float(
+    _os.environ.get("FISHNET_OVERLOAD_MOVE_P99_MS", 2000.0)
+)
+
+
+def run_overload_bench(
+    seconds: float = OVERLOAD_SECONDS,
+    tenants: int = OVERLOAD_TENANTS,
+    saturation: int = OVERLOAD_SATURATION,
+    high_watermark: int = OVERLOAD_WATERMARK,
+    cores: int = 3,
+    move_p99_budget_ms: float = OVERLOAD_MOVE_P99_BUDGET_MS,
+) -> dict:
+    """Saturation-serving benchmark (ISSUE 9): N tenant acquire streams
+    against an in-process fake server that refills faster than the
+    client can drain (``saturation``x), mock engine, real front end —
+    admission control sheds analysis work at the watermark while the
+    best-move lane keeps its p99.
+
+    Entirely transport- and device-free: the number measured is the
+    serving plane's queueing behavior, not the evaluator. Reports
+    latency percentiles (server-observed: handout -> first report /
+    move done), per-tenant fairness from the DRR scheduler's served
+    counts, max lane depths sampled through the run, shed accounting,
+    and the exactly-once ledger report."""
+    from fishnet_tpu.client import Client
+    from fishnet_tpu.engine.mock import MockEngineFactory
+    from fishnet_tpu.resilience import accounting
+    from fishnet_tpu.resilience.shedding import (
+        LANE_LATENCY,
+        LANE_THROUGHPUT,
+        ShedPolicy,
+    )
+    from fishnet_tpu.resilience.soak import _load_fake_server
+    from fishnet_tpu.utils.logger import Logger
+
+    fake = _load_fake_server()
+    ledger = accounting.install()
+
+    def _r(x):
+        return None if x is None else round(x, 1)
+
+    async def drive() -> dict:
+        async with fake.FakeServer() as server:
+            li = server.lichess
+            li.auto_refill = saturation * tenants * 2
+            li.refill_move_every = 4  # every 4th synthesized job: best-move
+            policy = ShedPolicy(high_watermark=high_watermark)
+            client = Client(
+                endpoint=server.endpoint,
+                key=fake.VALID_KEY,
+                cores=cores,
+                engine_factory=MockEngineFactory(delay_seconds=0.02),
+                logger=Logger(verbose=0),
+                max_backoff=0.2,
+                tenants=tenants,
+                shed_policy=policy,
+            )
+            await client.start()
+            frontend = client._frontend
+            assert frontend is not None, "overload bench needs tenants >= 2"
+            sched = frontend.state.scheduler
+            max_depth = {LANE_LATENCY: 0, LANE_THROUGHPUT: 0}
+            samples = 0
+            shed_activations = 0
+            was_shedding = False
+            loop = asyncio.get_running_loop()
+            t_end = loop.time() + seconds
+            while loop.time() < t_end:
+                for lane, depth in sched.depths().items():
+                    max_depth[lane] = max(max_depth.get(lane, 0), depth)
+                shedding = policy.shed_active
+                if shedding and not was_shedding:
+                    shed_activations += 1
+                was_shedding = shedding
+                samples += 1
+                await asyncio.sleep(0.02)
+            await client.stop(abort_pending=True)
+
+            move_lat = [
+                (li.move_done_at[k] - li.handed_at[k]) * 1e3
+                for k in li.move_done_at if k in li.handed_at
+            ]
+            first_analysis = [
+                (li.first_report_at[k] - li.handed_at[k]) * 1e3
+                for k in li.first_report_at if k in li.handed_at
+            ]
+            served = dict(sched.served)
+            positive = [v for v in served.values() if v > 0]
+            fairness_ratio = (
+                round(max(positive) / min(positive), 3)
+                if len(positive) >= 2 else None
+            )
+            led_report = ledger.report()
+            move_p99 = _percentile(move_lat, 99)
+            # Admission is checked per batch BEFORE its positions are
+            # pushed, so depth can overshoot the watermark by at most
+            # the batches every tenant had in flight at the crossing.
+            depth_bound = high_watermark + tenants * 8
+            return {
+                "metric": "overload_move_p99_ms",
+                "value": round(move_p99, 1) if move_p99 is not None else None,
+                "unit": "ms",
+                "mode": "overload",
+                "tenants": tenants,
+                "seconds": seconds,
+                "latency": {
+                    "move_p50_ms": _r(_percentile(move_lat, 50)),
+                    "move_p99_ms": _r(move_p99),
+                    "move_n": len(move_lat),
+                    "move_p99_budget_ms": move_p99_budget_ms,
+                    "move_within_budget": (
+                        move_p99 is not None and move_p99 <= move_p99_budget_ms
+                    ),
+                    "analysis_first_p50_ms": _r(_percentile(first_analysis, 50)),
+                    "analysis_first_p99_ms": _r(_percentile(first_analysis, 99)),
+                    "analysis_n": len(first_analysis),
+                },
+                "shedding": {
+                    "shed_total": sum(
+                        ts.shed for ts in frontend.tenants.values()
+                    ),
+                    "admitted_total": sum(
+                        ts.acquired for ts in frontend.tenants.values()
+                    ),
+                    "shed_by_tenant": {
+                        ts.name: ts.shed for ts in frontend.tenants.values()
+                    },
+                    "activations": shed_activations,
+                    "policy": frontend.shed_policy.snapshot(),
+                },
+                "fairness": {
+                    "served_by_tenant": served,
+                    "ratio": fairness_ratio,
+                },
+                "queue": {
+                    "max_latency_depth": max_depth.get(LANE_LATENCY, 0),
+                    "max_throughput_depth": max_depth.get(LANE_THROUGHPUT, 0),
+                    "depth_bound": depth_bound,
+                    "bounded": max_depth.get(LANE_THROUGHPUT, 0) <= depth_bound,
+                    "samples": samples,
+                },
+                "ledger": led_report,
+                "server": {
+                    "acquires": li.acquire_count,
+                    "analyses_completed": len(li.analyses),
+                    "moves_completed": len(li.moves),
+                    "aborted": len(li.aborted),
+                    "jobs_synthesized": li.refill_count,
+                },
+            }
+
+    try:
+        return asyncio.run(drive())
+    finally:
+        accounting.clear()
 
 
 def bench_search_quality() -> dict:
@@ -946,7 +1157,36 @@ def main(argv=None) -> None:
         help="also write the summary JSON whole to this path "
         "(default: bench_summary.json; empty string disables)",
     )
+    parser.add_argument(
+        "--overload", action="store_true",
+        help="run the saturation-serving benchmark instead of the "
+        "throughput tiers: multi-tenant front end + fake server + mock "
+        "engine, reporting latency percentiles, fairness, shedding, and "
+        "ledger accounting (device-free; see run_overload_bench)",
+    )
+    parser.add_argument(
+        "--overload-seconds", type=float, default=OVERLOAD_SECONDS,
+        help="overload-mode measurement window (default: "
+        f"{OVERLOAD_SECONDS:.0f}s)",
+    )
+    parser.add_argument(
+        "--tenants", type=int, default=OVERLOAD_TENANTS,
+        help="overload-mode concurrent acquire streams (default: "
+        f"{OVERLOAD_TENANTS})",
+    )
     args = parser.parse_args(argv)
+
+    if args.overload:
+        log(
+            f"bench: overload mode — {args.tenants} tenants, "
+            f"{OVERLOAD_SATURATION}x saturating load, "
+            f"{args.overload_seconds:.0f}s window..."
+        )
+        summary = run_overload_bench(
+            seconds=args.overload_seconds, tenants=args.tenants
+        )
+        emit_summary(summary, args.json_out)
+        return
 
     from fishnet_tpu.nnue.weights import NnueWeights
     from fishnet_tpu.search.service import SearchService

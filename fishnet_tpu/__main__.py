@@ -204,7 +204,11 @@ def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
             lambda rung: build_search_service(opt, logger, psqt_path=rung),
             logger=logger,
         )
-        return TpuNnueEngineFactory(service_builder=supervisor.build)
+        factory = TpuNnueEngineFactory(service_builder=supervisor.build)
+        # Exposed so run_client can hand the ladder to the front end's
+        # shed policy (a degraded rung shrinks admission capacity).
+        factory.supervisor = supervisor
+        return factory
     if engine == "az-mcts":
         import jax
 
@@ -306,6 +310,17 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         )
 
     engine_factory = build_engine_factory(opt, logger)
+    shed_policy = None
+    if opt.lane_depth_limit is not None:
+        from fishnet_tpu.resilience.shedding import ShedPolicy
+        from fishnet_tpu.resilience.supervisor import any_breaker_open
+
+        sup = getattr(engine_factory, "supervisor", None)
+        shed_policy = ShedPolicy(
+            high_watermark=opt.lane_depth_limit,
+            breaker_open_fn=any_breaker_open,
+            rung_fn=(lambda: sup.rung) if sup is not None else None,
+        )
     client = Client(
         endpoint=opt.resolved_endpoint(),
         key=opt.key,
@@ -317,6 +332,9 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         max_backoff=opt.resolved_max_backoff(),
         workers=opt.resolved_workers(),
         batch_deadline=opt.batch_deadline,
+        tenants=opt.resolved_tenants(),
+        shed_policy=shed_policy,
+        supervisor=getattr(engine_factory, "supervisor", None),
     )
     if opt.resolved_workers() != opt.resolved_cores():
         shared = opt.resolved_engine() in ("tpu-nnue", "az-mcts")

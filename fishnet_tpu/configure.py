@@ -223,6 +223,17 @@ class Opt:
     #: queue (doc/resilience.md). None = no deadline (the reference
     #: model: the server's own timeout reassigns).
     batch_deadline: Optional[float] = None
+    #: Concurrent acquire streams (sched/frontend.py). >1 wires the
+    #: multi-tenant front end: priority lanes, DRR fairness, admission
+    #: control + load shedding. None/1 = the classic single stream.
+    tenants: Optional[int] = None
+    #: Admission-control high watermark: queued throughput-lane
+    #: positions past which analysis batches are shed (accounted abort;
+    #: the server reassigns). None = the shed policy default.
+    lane_depth_limit: Optional[int] = None
+
+    def resolved_tenants(self) -> int:
+        return self.tenants if self.tenants is not None else 1
 
     def conf_path(self) -> Path:
         return Path(self.conf) if self.conf else Path("fishnet.ini")
@@ -336,6 +347,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "batches older than this are flushed as partial "
                         "analyses instead of wedging the queue. Default: "
                         "no deadline.")
+    p.add_argument("--tenants", type=int, default=None,
+                   help="Concurrent acquire streams (multi-tenant front "
+                        "end with priority lanes, per-tenant fairness, and "
+                        "admission control; doc/resilience.md). Default: 1 "
+                        "(the classic single stream). "
+                        "FISHNET_NO_MULTITENANT=1 forces single-stream.")
+    p.add_argument("--lane-depth-limit", type=int, default=None,
+                   help="Admission-control high watermark: queued "
+                        "analysis-lane positions past which bulk batches "
+                        "are shed (accounted abort; the server reassigns). "
+                        "Default: the shed policy's built-in watermark.")
     return p
 
 
@@ -393,6 +415,14 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
         opt.batch_deadline = parse_duration(ns.batch_deadline)
         if opt.batch_deadline <= 0:
             raise ConfigError("--batch-deadline must be positive")
+    if ns.tenants is not None:
+        if ns.tenants < 1:
+            raise ConfigError("--tenants must be >= 1")
+        opt.tenants = ns.tenants
+    if ns.lane_depth_limit is not None:
+        if ns.lane_depth_limit < 1:
+            raise ConfigError("--lane-depth-limit must be >= 1")
+        opt.lane_depth_limit = ns.lane_depth_limit
     return opt
 
 
@@ -443,6 +473,9 @@ _INI_FIELDS = (
     ("SpansDir", "spans_dir", str),
     ("FaultPlan", "fault_plan", lambda v: _parse_fault_plan(v)),
     ("BatchDeadline", "batch_deadline", parse_duration),
+    ("Tenants", "tenants", lambda v: _positive_int(v, "Tenants")),
+    ("LaneDepthLimit", "lane_depth_limit",
+     lambda v: _positive_int(v, "LaneDepthLimit")),
 )
 
 

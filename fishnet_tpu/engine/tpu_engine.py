@@ -113,6 +113,10 @@ class TpuNnueEngine(Engine):
                 movetime_seconds=movetime,
                 variant=position.variant,
                 skill_level=skill,
+                # Serving lane: best-move jobs ride the latency lane,
+                # which suppresses the coalescer's batching linger
+                # while they are in flight (doc/resilience.md).
+                lane="throughput" if work.is_analysis else "latency",
             )
         except EngineError:
             raise

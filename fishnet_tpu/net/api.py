@@ -105,6 +105,43 @@ _PARKED = _telemetry.REGISTRY.gauge(
     "fishnet_api_parked_submissions",
     "Analysis submissions parked behind an open circuit breaker.",
 )
+_ACQUIRE_PACED = _telemetry.REGISTRY.counter(
+    "fishnet_acquire_paced_total",
+    "Acquire attempts slowed by shed-aware pacing (the front end is "
+    "shedding; pulling more bulk work would only be aborted back).",
+    labelnames=("tenant",),
+)
+
+#: Acquire-stream pause per pacing round while the shed policy is
+#: active. Long enough to let the queue drain meaningfully, short
+#: enough that latency-lane (move) jobs are still picked up promptly.
+SHED_PACE_SECONDS = 0.25
+
+
+class ShedAwarePacer:
+    """Slows a tenant's acquire stream while load shedding is active.
+
+    ``shed_active_fn`` probes the shared ShedPolicy
+    (resilience/shedding.py); the pacer sleeps one quantum per call
+    while it reports True. It deliberately slows rather than stops the
+    stream: admission control still sheds bulk batches on arrival, but
+    move jobs must keep flowing into the latency lane."""
+
+    def __init__(
+        self, shed_active_fn, tenant: str = "",
+        pause_seconds: float = SHED_PACE_SECONDS,
+    ) -> None:
+        self._shed_active_fn = shed_active_fn
+        self._tenant = tenant
+        self._pause = pause_seconds
+
+    async def pace(self) -> bool:
+        """Sleep one quantum if shedding; True if a pause was taken."""
+        if not self._shed_active_fn():
+            return False
+        _ACQUIRE_PACED.inc(tenant=self._tenant)
+        await asyncio.sleep(self._pause)
+        return True
 
 
 class KeyError_(Exception):
@@ -133,6 +170,17 @@ class ApiStub:
 
     _queue: "asyncio.Queue[_Message]"
     endpoint: str
+    #: Tenant name in multi-tenant mode ("" = single-stream client).
+    tenant: str = ""
+    #: Optional ShedAwarePacer consulted by acquire loops before each
+    #: acquire (sched/frontend.py installs one per tenant).
+    pacer: Optional[ShedAwarePacer] = None
+
+    async def pace_acquire(self) -> bool:
+        """Shed-aware pacing hook; True if a pause was taken."""
+        if self.pacer is None:
+            return False
+        return await self.pacer.pace()
 
     async def check_key(self) -> Optional[Exception]:
         """None if the key is accepted; the error otherwise."""
@@ -202,11 +250,13 @@ class ApiActor:
         endpoint: str,
         key: Optional[str],
         logger: Logger,
+        tenant: str = "",
     ) -> None:
         self.queue = queue
         self.endpoint = endpoint.rstrip("/")
         self.key = key
         self.logger = logger
+        self.tenant = tenant
         self.error_backoff = RandomizedBackoff()
         self._session: Optional[aiohttp.ClientSession] = None
         self._stopped = False
@@ -225,7 +275,7 @@ class ApiActor:
             cooldown_seconds=float(
                 _os.environ.get(BREAKER_COOLDOWN_ENV, "30")
             ),
-            name="submit",
+            name=f"submit:{tenant}" if tenant else "submit",
         )
         self._parked: List[_Message] = []
         self._breaker_wake: Optional[asyncio.TimerHandle] = None
@@ -587,9 +637,14 @@ class RateLimited(Exception):
     """HTTP 429: suspend all requests (api.rs:550-556)."""
 
 
-def channel(endpoint: str, key: Optional[str], logger: Logger) -> tuple:
-    """Create a connected (ApiStub, ApiActor) pair."""
+def channel(
+    endpoint: str, key: Optional[str], logger: Logger, tenant: str = ""
+) -> tuple:
+    """Create a connected (ApiStub, ApiActor) pair. ``tenant`` names
+    the owning acquire stream in multi-tenant mode (sched/frontend.py);
+    each tenant gets its own actor so error backoff, the submit
+    breaker, and 429 suspensions stay per-stream."""
     queue: "asyncio.Queue[_Message]" = asyncio.Queue()
-    stub = ApiStub(_queue=queue, endpoint=endpoint.rstrip("/"))
-    actor = ApiActor(queue, endpoint, key, logger)
+    stub = ApiStub(_queue=queue, endpoint=endpoint.rstrip("/"), tenant=tenant)
+    actor = ApiActor(queue, endpoint, key, logger, tenant=tenant)
     return stub, actor

@@ -57,6 +57,7 @@ SITES = (
     "engine.spawn",
     "service.device_step",
     "queue.schedule",
+    "queue.admit",
 )
 
 ACTIONS = ("error", "crash", "latency", "hang")

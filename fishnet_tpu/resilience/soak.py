@@ -19,6 +19,12 @@ Two phases, one process, one metrics registry:
   first device dispatch crashes the driver (``service.device_step``
   fault), the supervisor respawns the pool one rung down the
   degradation ladder (fused → xla), and the retried search succeeds.
+* **Phase C (overload)** — the multi-tenant front end under a
+  saturating load (the fake server refills faster than the client
+  drains) with ``queue.admit`` and ``net.submit`` faults layered on:
+  admission control must shed analysis work (accounted — abandoned
+  through the ledger and aborted back to the server), the throughput
+  lane's depth must stay bounded, and the ledger must still end clean.
 
 The run ends with a ``/metrics`` scrape asserting the four resilience
 metric families are exported (doc/resilience.md contract):
@@ -51,6 +57,12 @@ CANNED_PLAN = (
     "engine.spawn:nth=1:error;"
     "service.device_step:nth=1:crash"
 )
+
+#: Phase C fault plan, installed after A/B complete: admission-layer
+#: failures (degraded to accounted sheds) plus a submit failure mid-
+#: saturation. Deterministic seed; probabilities keep the overload loop
+#: exercised without starving it.
+PHASE_C_PLAN = "seed=9;queue.admit:p=0.05:error;net.submit:nth=3:error"
 
 #: The resilience metric-family contract the final scrape must include.
 REQUIRED_FAMILIES = (
@@ -180,6 +192,71 @@ async def _phase_b_service(logger, report: Dict) -> None:
     }
 
 
+async def _phase_c_overload(fake_server_mod, logger, report: Dict) -> None:
+    """Multi-tenant front end under saturating load + admission faults."""
+    from fishnet_tpu.client import Client
+    from fishnet_tpu.engine.mock import MockEngineFactory
+    from fishnet_tpu.resilience import faults
+    from fishnet_tpu.resilience.shedding import LANE_THROUGHPUT, ShedPolicy
+
+    t0 = time.monotonic()
+    high = 16
+    tenants = 4
+    async with fake_server_mod.FakeServer() as server:
+        li = server.lichess
+        li.work_id_prefix = "oc"  # distinct from phase A's ids in the ledger
+        li.auto_refill = 16  # never drains: 4x what two workers clear
+        li.refill_move_every = 4
+        client = Client(
+            endpoint=server.endpoint,
+            key=fake_server_mod.VALID_KEY,
+            cores=2,
+            engine_factory=MockEngineFactory(delay_seconds=0.02),
+            logger=logger,
+            max_backoff=0.2,
+            tenants=tenants,
+            shed_policy=ShedPolicy(high_watermark=high),
+        )
+        await client.start()
+        frontend = client._frontend
+        assert frontend is not None, "phase C needs the multi-tenant path"
+        sched = frontend.state.scheduler
+        max_depth = 0
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline:
+            max_depth = max(max_depth, sched.depth(LANE_THROUGHPUT))
+            await asyncio.sleep(0.02)
+        await client.stop(abort_pending=True)
+        shed_total = sum(ts.shed for ts in frontend.tenants.values())
+        admitted = sum(ts.acquired for ts in frontend.tenants.values())
+        depth_bound = high + tenants * 8
+        report["phase_c"] = {
+            "tenants": tenants,
+            "shed": shed_total,
+            "admitted": admitted,
+            "max_throughput_depth": max_depth,
+            "depth_bound": depth_bound,
+            "served_by_tenant": dict(sched.served),
+            "faults": faults.current().counts() if faults.current() else {},
+            "moves_completed": len(li.moves),
+            "analyses_completed": len(li.analyses),
+            "seconds": round(time.monotonic() - t0, 2),
+        }
+        if shed_total < 1:
+            raise AssertionError(
+                f"phase C: saturation never shed: {report['phase_c']}"
+            )
+        if admitted < 1:
+            raise AssertionError(
+                f"phase C: nothing admitted: {report['phase_c']}"
+            )
+        if max_depth > depth_bound:
+            raise AssertionError(
+                f"phase C: throughput lane unbounded "
+                f"({max_depth} > {depth_bound}): {report['phase_c']}"
+            )
+
+
 def _scrape(port: int) -> str:
     with urllib.request.urlopen(
         f"http://127.0.0.1:{port}/metrics", timeout=5
@@ -232,10 +309,15 @@ async def run_soak(
         ledger = accounting.install()
         await _phase_a_client(fake_server_mod, logger, report)
         await _phase_b_service(logger, report)
+        ab_fault_counts = faults.current().counts()
+        # Phase C runs under its own plan (admission + submit faults);
+        # the A/B counts are captured above so the report keeps both.
+        faults.install(PHASE_C_PLAN)
+        await _phase_c_overload(fake_server_mod, logger, report)
 
         report["ledger"] = ledger.assert_clean()
         report["counters"] = {
-            "faults_injected": faults.current().counts(),
+            "faults_injected": ab_fault_counts,
             "requeued": queue_mod._REQUEUED.value() - base["requeued"],
             "respawns": supervisor_mod._RESPAWNS.value() - base["respawns"],
             "degradations_fused_to_xla": supervisor_mod._DEGRADATIONS.value(

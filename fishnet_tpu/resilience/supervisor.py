@@ -30,7 +30,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional
+import weakref
+from typing import Callable, Dict, List, Optional
 
 from fishnet_tpu import telemetry as _telemetry
 from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
@@ -58,6 +59,22 @@ _BREAKER_STATE = _telemetry.REGISTRY.gauge(
 #: Span stage recorded around every supervised rebuild — the seventh
 #: stage next to the six pipeline stages (doc/observability.md).
 RECOVER_STAGE = "recover"
+
+#: Live breakers, by name, for the /healthz serving-state view
+#: (telemetry/exporter.py). Weak references: a finished client's
+#: breakers vanish from the report without any unregistration dance.
+_BREAKERS: "weakref.WeakValueDictionary[str, CircuitBreaker]" = (
+    weakref.WeakValueDictionary()
+)
+
+
+def breaker_states() -> Dict[str, str]:
+    """Name -> state for every live CircuitBreaker in the process."""
+    return {name: br.state for name, br in sorted(_BREAKERS.items())}
+
+
+def any_breaker_open() -> bool:
+    return any(br.state == CircuitBreaker.OPEN for br in _BREAKERS.values())
 
 
 class RespawnBudgetExhausted(RuntimeError):
@@ -96,6 +113,7 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._export()
+        _BREAKERS[name] = self
 
     def _export(self) -> None:
         _BREAKER_STATE.set(

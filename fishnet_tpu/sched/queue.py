@@ -33,6 +33,11 @@ from fishnet_tpu import telemetry as _telemetry
 from fishnet_tpu.chess import Board, InvalidFenError, UnsupportedVariantError
 from fishnet_tpu.resilience import accounting as _accounting
 from fishnet_tpu.resilience import faults as _faults
+from fishnet_tpu.resilience.shedding import (
+    LANE_LATENCY,
+    LANE_THROUGHPUT,
+    LANES,
+)
 from fishnet_tpu.telemetry import tracing as _tracing
 from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 from fishnet_tpu.ipc import Position, PositionFailed, PositionResponse
@@ -75,6 +80,18 @@ _QUEUE_ERRORS = _telemetry.REGISTRY.counter(
     "fishnet_queue_exceptions_total",
     "Unexpected exceptions caught (and survived) by the queue actor.",
 )
+
+#: DRR quantum: positions a tenant may drain from the throughput lane
+#: per scheduling turn. Large enough to keep a whole small batch
+#: together (cache-friendly for the coalescer), small enough that no
+#: tenant monopolizes a turn.
+DRR_QUANTUM = 8
+
+
+def lane_of_work(work: Work) -> str:
+    """Best-move jobs ride the latency lane; analysis the throughput
+    lane (resilience/shedding.py)."""
+    return LANE_LATENCY if work.is_move else LANE_THROUGHPUT
 
 
 class _Skip:
@@ -209,6 +226,11 @@ class PendingBatch:
     #: set when telemetry is on: ``queue_wait`` spans recorded at
     #: worker-pull time chain under it. None with telemetry off.
     trace: Optional[object] = None
+    #: Owning tenant ("" in single-tenant mode) — routes submissions
+    #: back through the acquiring tenant's api actor.
+    tenant: str = ""
+    #: Serving lane (resilience/shedding.py).
+    lane: str = LANE_THROUGHPUT
 
     def pending(self) -> int:
         return sum(1 for p in self.positions if p is None)
@@ -225,6 +247,7 @@ class PendingBatch:
             started_at=self.started_at,
             completed_at=now,
             url=self.url,
+            tenant=self.tenant,
         )
 
     def try_into_completed(self) -> Optional["CompletedBatch"]:
@@ -238,6 +261,7 @@ class PendingBatch:
             started_at=self.started_at,
             completed_at=time.monotonic(),
             url=self.url,
+            tenant=self.tenant,
         )
 
     def progress_report(self) -> List[Optional[AnalysisPartJson]]:
@@ -261,6 +285,7 @@ class CompletedBatch:
     started_at: float
     completed_at: float
     url: Optional[str] = None
+    tenant: str = ""
 
     def into_analysis(self) -> List[Optional[AnalysisPartJson]]:
         out: List[Optional[AnalysisPartJson]] = []
@@ -291,6 +316,133 @@ class CompletedBatch:
 
 
 # ---------------------------------------------------------------------------
+# Multi-tenant lane scheduler
+# ---------------------------------------------------------------------------
+
+
+class LaneScheduler:
+    """Two priority lanes x N tenants with deficit-round-robin fairness.
+
+    Pop order is strict priority: the latency lane (best-move jobs)
+    always drains first, plain round-robin across tenants — move jobs
+    are rare and tiny, so strict priority cannot starve the bulk lane
+    in practice while it guarantees the interactive p99. The
+    throughput lane runs classic DRR with unit cost per position: each
+    turn a tenant gets ``quantum`` credits and serves until they run
+    out or its queue empties, then the turn rotates. Tenants with
+    nothing queued hold no turn, so fairness is over *active* tenants
+    (max/min served ratio bounded near 1 under sustained load).
+
+    Single-threaded by construction — only the queue actor's event
+    loop touches it; the metrics collector reads ``served``/depths as
+    racy snapshots, which is fine for gauges.
+    """
+
+    def __init__(self, quantum: int = DRR_QUANTUM) -> None:
+        self.quantum = max(1, int(quantum))
+        # lane -> tenant -> FIFO of Position
+        self._queues: Dict[str, Dict[str, Deque[Position]]] = {
+            lane: {} for lane in LANES
+        }
+        self._rings: Dict[str, Deque[str]] = {lane: deque() for lane in LANES}
+        self._credit: Dict[str, int] = {}  # throughput-lane DRR deficits
+        #: Positions handed to workers, per tenant (fairness measure).
+        self.served: Dict[str, int] = {}
+
+    def push(
+        self, position: Position, tenant: str, lane: str,
+        front: bool = False,
+    ) -> None:
+        queues = self._queues[lane]
+        q = queues.get(tenant)
+        if q is None:
+            q = queues[tenant] = deque()
+        if front:
+            q.appendleft(position)
+        else:
+            q.append(position)
+        ring = self._rings[lane]
+        if tenant not in ring:
+            ring.append(tenant)
+
+    def _pop_latency(self) -> Optional[Position]:
+        ring = self._rings[LANE_LATENCY]
+        queues = self._queues[LANE_LATENCY]
+        while ring:
+            q = queues.get(ring[0])
+            if not q:
+                ring.popleft()
+                continue
+            position = q.popleft()
+            ring.rotate(-1)
+            return position
+        return None
+
+    def _pop_throughput(self) -> Optional[Position]:
+        ring = self._rings[LANE_THROUGHPUT]
+        queues = self._queues[LANE_THROUGHPUT]
+        while ring:
+            tenant = ring[0]
+            q = queues.get(tenant)
+            if not q:
+                # Queue drained mid-turn: the tenant leaves the ring
+                # (and forfeits leftover credit) until new work arrives.
+                ring.popleft()
+                self._credit.pop(tenant, None)
+                continue
+            credit = self._credit.get(tenant)
+            if credit is None:
+                credit = self._credit[tenant] = self.quantum
+            if credit <= 0:
+                # Turn over: rotate to the back; credit refills on the
+                # next visit.
+                del self._credit[tenant]
+                ring.rotate(-1)
+                continue
+            self._credit[tenant] = credit - 1
+            return q.popleft()
+        return None
+
+    def pop(self) -> Optional[Position]:
+        position = self._pop_latency()
+        if position is None:
+            position = self._pop_throughput()
+        return position
+
+    def note_served(self, tenant: str) -> None:
+        self.served[tenant] = self.served.get(tenant, 0) + 1
+
+    def drop_batch(self, batch_id: str) -> int:
+        """Remove every queued position of ``batch_id``; returns the
+        number removed."""
+        dropped = 0
+        for queues in self._queues.values():
+            for tenant, q in queues.items():
+                kept = deque(p for p in q if p.work.id != batch_id)
+                dropped += len(q) - len(kept)
+                queues[tenant] = kept
+        return dropped
+
+    def clear(self) -> int:
+        """Drop everything (hard shutdown); returns the count dropped."""
+        dropped = len(self)
+        for lane in LANES:
+            self._queues[lane] = {}
+            self._rings[lane].clear()
+        self._credit.clear()
+        return dropped
+
+    def depth(self, lane: str) -> int:
+        return sum(len(q) for q in self._queues[lane].values())
+
+    def depths(self) -> Dict[str, int]:
+        return {lane: self.depth(lane) for lane in LANES}
+
+    def __len__(self) -> int:
+        return sum(self.depth(lane) for lane in LANES)
+
+
+# ---------------------------------------------------------------------------
 # Queue state shared between stub and actor
 # ---------------------------------------------------------------------------
 
@@ -313,7 +465,7 @@ def _register_queue_collector(state: "QueueState") -> int:
         backlog = 0.0 if oldest is None else max(
             0.0, time.monotonic() - oldest
         )
-        return [
+        families = [
             _telemetry.gauge_family(
                 "fishnet_queue_pending_positions",
                 "Positions assigned to workers but not yet analysed.",
@@ -325,7 +477,7 @@ def _register_queue_collector(state: "QueueState") -> int:
             ),
             _telemetry.gauge_family(
                 "fishnet_queue_incoming_positions",
-                "Positions queued for worker pull.", len(st.incoming),
+                "Positions queued for worker pull.", st.incoming_len(),
             ),
             _telemetry.gauge_family(
                 "fishnet_queue_backlog_seconds",
@@ -337,6 +489,30 @@ def _register_queue_collector(state: "QueueState") -> int:
                 len(st.move_submissions),
             ),
         ]
+        sched = st.scheduler
+        if sched is not None:
+            families.append(_telemetry.MetricFamily(
+                "fishnet_lane_depth", "gauge",
+                "Positions queued per serving lane.",
+                [
+                    _telemetry.Sample(
+                        "fishnet_lane_depth", float(depth), {"lane": lane}
+                    )
+                    for lane, depth in sched.depths().items()
+                ],
+            ))
+            families.append(_telemetry.MetricFamily(
+                "fishnet_tenant_positions_served", "gauge",
+                "Positions handed to workers, per tenant (fairness).",
+                [
+                    _telemetry.Sample(
+                        "fishnet_tenant_positions_served",
+                        float(count), {"tenant": tenant},
+                    )
+                    for tenant, count in sorted(sched.served.items())
+                ],
+            ))
+        return families
 
     return _telemetry.REGISTRY.register_collector(collect, name="queue")
 
@@ -348,6 +524,8 @@ class QueueState:
         stats: StatsRecorder,
         logger: Logger,
         batch_deadline: Optional[float] = None,
+        scheduler: Optional[LaneScheduler] = None,
+        api_router=None,
     ) -> None:
         self.shutdown_soon = False
         self.cores = cores
@@ -358,6 +536,61 @@ class QueueState:
         self.logger = logger
         #: Per-batch deadline budget (seconds); None = no deadline.
         self.batch_deadline = batch_deadline
+        #: Multi-tenant mode: a LaneScheduler replaces the single
+        #: ``incoming`` deque (every access goes through the
+        #: ``incoming_*`` methods below, which keep the legacy deque
+        #: path byte-for-byte when no scheduler is installed).
+        self.scheduler = scheduler
+        #: Callable[[tenant], Optional[ApiStub]] — routes submissions
+        #: back through the acquiring tenant's api actor. None in
+        #: single-tenant mode (the stub/actor default applies).
+        self.api_router = api_router
+
+    # -- incoming-queue access (legacy deque vs lane scheduler) -----------
+
+    def incoming_len(self) -> int:
+        if self.scheduler is not None:
+            return len(self.scheduler)
+        return len(self.incoming)
+
+    def incoming_push(
+        self, position: Position, tenant: str = "",
+        lane: str = LANE_THROUGHPUT, front: bool = False,
+    ) -> None:
+        if self.scheduler is not None:
+            self.scheduler.push(position, tenant, lane, front=front)
+        elif front:
+            self.incoming.appendleft(position)
+        else:
+            self.incoming.append(position)
+
+    def incoming_pop(self) -> Optional[Position]:
+        if self.scheduler is not None:
+            return self.scheduler.pop()
+        return self.incoming.popleft() if self.incoming else None
+
+    def incoming_drop_batch(self, batch_id: str) -> None:
+        if self.scheduler is not None:
+            self.scheduler.drop_batch(batch_id)
+        else:
+            self.incoming = deque(
+                p for p in self.incoming if p.work.id != batch_id
+            )
+
+    def incoming_clear(self) -> None:
+        if self.scheduler is not None:
+            self.scheduler.clear()
+        else:
+            self.incoming.clear()
+
+    def api_for(self, batch, default: ApiStub) -> ApiStub:
+        """The api stub that owns ``batch`` (its tenant's actor in
+        multi-tenant mode, the shared default otherwise)."""
+        if self.api_router is not None and batch.tenant:
+            stub = self.api_router(batch.tenant)
+            if stub is not None:
+                return stub
+        return default
 
     def flush_expired(self, api: ApiStub) -> int:
         """Enforce the per-batch deadline budget: analysis batches older
@@ -375,9 +608,7 @@ class QueueState:
             if now - batch.started_at <= self.batch_deadline:
                 continue
             del self.pending[batch_id]
-            self.incoming = deque(
-                p for p in self.incoming if p.work.id != batch_id
-            )
+            self.incoming_drop_batch(batch_id)
             led = _accounting.get()
             if batch.work.is_analysis:
                 _FLUSHED.inc()
@@ -393,7 +624,7 @@ class QueueState:
                     f"{self.batch_deadline:.0f}s deadline; flushing "
                     f"{done}/{len(completed.positions)} analysed plies."
                 )
-                api.submit_analysis(
+                self.api_for(batch, api).submit_analysis(
                     completed.work.id,
                     completed.flavor.eval_flavor(),
                     completed.into_analysis(),
@@ -406,7 +637,7 @@ class QueueState:
                 self.logger.error(
                     f"Move job {batch_id} exceeded its deadline; aborting."
                 )
-                api.abort(batch_id)
+                self.api_for(batch, api).abort(batch_id)
             flushed += 1
         return flushed
 
@@ -417,43 +648,55 @@ class QueueState:
 
     def try_pull(self, callback: asyncio.Future) -> bool:
         """Serve a queued position to a worker callback; False if empty."""
-        while self.incoming:
-            position = self.incoming.popleft()
-            if not callback.done():
-                if _telemetry.enabled():
-                    # "queue_wait" span: this position's dwell in the
-                    # incoming queue, from batch enqueue to this pull —
-                    # a child of the batch's schedule span (the context
-                    # stashed on PendingBatch at accept time).
-                    batch = self.pending.get(position.work.id)
-                    if batch is not None and batch.trace is not None:
-                        _SPANS.record(
-                            "queue_wait", batch.started_at,
-                            trace=batch.trace.child(),
-                            batch=position.work.id,
-                            position_id=position.position_id,
-                        )
-                callback.set_result(position)
-                return True
-            # Callback abandoned (worker gone): keep the position.
-            self.incoming.appendleft(position)
+        position = self.incoming_pop()
+        if position is None:
+            return False
+        batch = self.pending.get(position.work.id)
+        if not callback.done():
+            if _telemetry.enabled():
+                # "queue_wait" span: this position's dwell in the
+                # incoming queue, from batch enqueue to this pull —
+                # a child of the batch's schedule span (the context
+                # stashed on PendingBatch at accept time).
+                if batch is not None and batch.trace is not None:
+                    _SPANS.record(
+                        "queue_wait", batch.started_at,
+                        trace=batch.trace.child(),
+                        batch=position.work.id,
+                        position_id=position.position_id,
+                    )
+            callback.set_result(position)
+            if self.scheduler is not None and batch is not None:
+                self.scheduler.note_served(batch.tenant)
             return True
-        return False
+        # Callback abandoned (worker gone): keep the position, front of
+        # its own lane/tenant queue so ordering is preserved.
+        if batch is not None:
+            self.incoming_push(
+                position, tenant=batch.tenant, lane=batch.lane, front=True
+            )
+        else:
+            self.incoming_push(position, front=True)
+        return True
 
     def add_incoming_batch(
-        self, batch: IncomingBatch, trace: Optional[object] = None
+        self,
+        batch: IncomingBatch,
+        trace: Optional[object] = None,
+        tenant: str = "",
     ) -> None:
         batch_id = batch.work.id
         if batch_id in self.pending:
             self.logger.error(f"Dropping duplicate incoming batch {batch_id}")
             return
+        lane = lane_of_work(batch.work)
         placeholders: List[object] = []
         for pos in batch.positions:
             if pos is SKIP:
                 placeholders.append(SKIP)
             else:
                 placeholders.append(None)
-                self.incoming.append(pos)
+                self.incoming_push(pos, tenant=tenant, lane=lane)
         self.pending[batch_id] = PendingBatch(
             work=batch.work,
             flavor=batch.flavor,
@@ -463,6 +706,8 @@ class QueueState:
             url=batch.url,
             sources=list(batch.positions),
             trace=trace,
+            tenant=tenant,
+            lane=lane,
         )
         led = _accounting.get()
         if led is not None:
@@ -508,7 +753,7 @@ class QueueStub:
         self._state.flush_expired(self._api)
         if self._state.try_pull(pull.callback):
             return
-        if self._state.shutdown_soon and not self._state.incoming:
+        if self._state.shutdown_soon and not self._state.incoming_len():
             # Drain complete for this worker; release it (the reference
             # releases workers by dropping their callbacks, main.rs:374-382).
             if not pull.callback.done():
@@ -570,9 +815,7 @@ class QueueStub:
                 else "position_failed"
             )
             state.pending.pop(res.batch_id, None)
-            state.incoming = deque(
-                p for p in state.incoming if p.work.id != res.batch_id
-            )
+            state.incoming_drop_batch(res.batch_id)
             _ABANDONED.inc(reason=reason)
             if led is not None:
                 led.record_abandoned(res.batch_id, reason)
@@ -585,7 +828,9 @@ class QueueStub:
         _REQUEUED.inc()
         if led is not None:
             led.record_requeued(res.batch_id, batch.generation)
-        state.incoming.appendleft(src)
+        state.incoming_push(
+            src, tenant=batch.tenant, lane=batch.lane, front=True
+        )
         state.logger.debug(
             f"Requeued position {res.position_id} of {res.batch_id} "
             f"(generation {batch.generation}/{MAX_REQUEUE_GENERATIONS})."
@@ -602,7 +847,7 @@ class QueueStub:
                 report = pending.progress_report()
                 done = sum(1 for p in report if p is not None)
                 if done and done % (state.cores * 2) == 0:
-                    self._api.submit_analysis(
+                    state.api_for(pending, self._api).submit_analysis(
                         pending.work.id, pending.flavor.eval_flavor(), report
                     )
             state.pending[batch_id] = pending
@@ -628,7 +873,7 @@ class QueueStub:
 
         if completed.work.is_analysis:
             state.logger.info(log)
-            self._api.submit_analysis(
+            state.api_for(completed, self._api).submit_analysis(
                 completed.work.id,
                 completed.flavor.eval_flavor(),
                 completed.into_analysis(),
@@ -655,10 +900,13 @@ class QueueStub:
         self.shutdown_soon()
         led = _accounting.get()
         for batch_id in list(self._state.pending):
-            del self._state.pending[batch_id]
+            batch = self._state.pending.pop(batch_id)
             if led is not None:
                 led.record_abandoned(batch_id, "shutdown_abort")
-            self._api.abort(batch_id)
+            self._state.api_for(batch, self._api).abort(batch_id)
+        # The queued positions belonged to the batches just aborted;
+        # drop them too so the drain check sees an empty queue.
+        self._state.incoming_clear()
 
     def stats(self) -> Tuple[Stats, NpsRecorder]:
         return (
@@ -765,7 +1013,25 @@ class QueueActor:
                     batch=context, outcome="invalid",
                 )
             return
-        self.state.add_incoming_batch(incoming, trace=sched_ctx)
+        if self.state.shutdown_soon:
+            # Accepted while shutting down (an in-flight acquire
+            # resolving after shutdown()): nobody will run it. Abandon
+            # it through the ledger and hand it back to the server
+            # instead of dropping it on the floor.
+            _ABANDONED.inc(reason="shutdown_incoming")
+            led = _accounting.get()
+            if led is not None:
+                led.record_abandoned(context, "shutdown_incoming")
+            self.api.abort(context)
+            if tel:
+                _SPANS.record(
+                    "schedule", t0, trace=sched_ctx,
+                    batch=context, outcome="shutdown",
+                )
+            return
+        self.state.add_incoming_batch(
+            incoming, trace=sched_ctx, tenant=self.api.tenant
+        )
         if tel:
             _SPANS.record(
                 "schedule", t0, trace=sched_ctx,
@@ -810,7 +1076,7 @@ class QueueActor:
                     self.logger.error(f"Queue error: {err!r}")
                     if not callback.done():
                         callback.cancel()
-                if self.state.shutdown_soon and not self.state.incoming:
+                if self.state.shutdown_soon and not self.state.incoming_len():
                     break
         finally:
             # Release any workers still parked in the mailbox.

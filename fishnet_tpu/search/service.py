@@ -634,7 +634,12 @@ class _DispatchCoalescer:
         flushes everything parked (the ticket included, unless another
         thread's flush already claimed it)."""
         if not ticket.done.is_set():
-            if self._linger_s > 0.0:
+            # Lane-aware demand: the linger trades a sub-RTT delay for
+            # fuller fused dispatches — a good trade for bulk analysis,
+            # a bad one while an interactive best-move search is in
+            # flight. Skip it entirely in that case (racy read; worst
+            # case is one lingered or one solo dispatch).
+            if self._linger_s > 0.0 and self._svc._latency_active == 0:
                 deadline = time.monotonic() + self._linger_s
                 with self._cond:
                     while (
@@ -1351,6 +1356,10 @@ class SearchService:
         self.failure_listener = None
         self._wakes = [threading.Event() for _ in range(T)]
         self._rr = 0  # round-robin submission cursor over threads
+        #: Latency-lane searches in flight (sched/frontend.py best-move
+        #: jobs): while nonzero, the coalescer's demand() skips its
+        #: linger so batch-filling never taxes interactive latency.
+        self._latency_active = 0
         self._stopping = False
         self._threads = [
             threading.Thread(
@@ -1382,6 +1391,7 @@ class SearchService:
         variant: Variant = Variant.STANDARD,
         stop_event: Optional[threading.Event] = None,
         skill_level: int = 20,
+        lane: str = "throughput",
     ) -> SearchResultData:
         """...with ``stop_event``: setting it (then ``poke()``) stops the
         native search gracefully — the call still returns the partial
@@ -1389,10 +1399,14 @@ class SearchService:
         discards the search. ``skill_level`` −9..20: below 20 the native
         search samples its best move among near-best candidate lines so
         play jobs genuinely weaken (api.rs:222-273 parity); analysis
-        callers leave the default full strength."""
+        callers leave the default full strength. ``lane`` is the serving
+        lane (resilience/shedding.py): while any "latency" search is in
+        flight, the dispatch coalescer skips its cross-thread linger so
+        interactive best-move latency is never taxed to fill batches."""
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         token = object()
+        latency = lane == "latency"
         with self._lock:
             if self._stopping:
                 raise NativeCoreError("search service is shut down")
@@ -1405,6 +1419,8 @@ class SearchService:
                 (root_fen, " ".join(moves), nodes, depth, multipv, future, loop,
                  movetime_seconds, variant, token, stop_event, skill_level)
             )
+            if latency:
+                self._latency_active += 1
         self._wakes[t].set()
         try:
             return await future
@@ -1423,6 +1439,10 @@ class SearchService:
                         break
             self._wakes[t].set()
             raise
+        finally:
+            if latency:
+                with self._lock:
+                    self._latency_active -= 1
 
     def _row_tiers(self, size: int) -> List[int]:
         """Packed-row shape buckets for an entry bucket of ``size``.
@@ -1652,6 +1672,7 @@ class SearchService:
         # host->device payload bytes shipped (the compact wire's metric),
         # split feature vs material so the ABI 9 saving is measurable.
         out["eval_steps"] = sum(self._eval_steps)
+        out["latency_active"] = self._latency_active
         out["bucket_slots"] = sum(self._bucket_slots)
         out["wire_feature_bytes"] = sum(self._wire_feature_bytes)
         out["wire_material_bytes"] = sum(self._wire_material_bytes)

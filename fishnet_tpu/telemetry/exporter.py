@@ -15,7 +15,13 @@ Endpoints:
 * ``GET /spans``   — current flight-recorder contents as JSON
 * ``GET /trace``   — same contents as a Chrome/Perfetto trace (load
   the response body at https://ui.perfetto.dev)
-* ``GET /healthz`` — liveness probe (``ok``)
+* ``GET /healthz`` — serving-state probe. With no registered health
+  providers it is a bare liveness check (200 ``ok``). Serving
+  subsystems (the multi-tenant front end, sched/frontend.py) register
+  providers; the probe then returns a JSON state document — ladder
+  rung, breaker states, shed-active, queue depths — with **503 while
+  shedding or unhealthy**, so a load balancer drains an overloaded
+  worker instead of routing more traffic at it.
 """
 
 from __future__ import annotations
@@ -23,9 +29,63 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from fishnet_tpu.telemetry.registry import REGISTRY, MetricsRegistry
+
+#: Health providers: name -> zero-arg callable returning a dict of
+#: serving state (or None to self-unregister, the collector idiom).
+#: A provider dict with ``healthy: False`` or ``shedding: True`` turns
+#: the probe non-200.
+_HEALTH_PROVIDERS: Dict[str, Callable[[], Optional[dict]]] = {}
+_HEALTH_LOCK = threading.Lock()
+
+
+def register_health_provider(
+    name: str, fn: Callable[[], Optional[dict]]
+) -> str:
+    """Register (or replace) a named serving-state provider for
+    /healthz. Returns the name (the unregister handle)."""
+    with _HEALTH_LOCK:
+        _HEALTH_PROVIDERS[name] = fn
+    return name
+
+
+def unregister_health_provider(name: str) -> None:
+    with _HEALTH_LOCK:
+        _HEALTH_PROVIDERS.pop(name, None)
+
+
+def health_snapshot() -> Tuple[int, Optional[dict]]:
+    """(status_code, body) for /healthz; body None means the bare
+    liveness ``ok`` (no providers registered)."""
+    with _HEALTH_LOCK:
+        providers = list(_HEALTH_PROVIDERS.items())
+    stale = []
+    states: Dict[str, dict] = {}
+    for name, fn in providers:
+        try:
+            state = fn()
+        except Exception:  # noqa: BLE001 - a broken probe must not 500
+            state = {"healthy": False, "error": "provider raised"}
+        if state is None:
+            stale.append(name)
+            continue
+        states[name] = state
+    if stale:
+        with _HEALTH_LOCK:
+            for name in stale:
+                _HEALTH_PROVIDERS.pop(name, None)
+    if not states:
+        return 200, None
+    unhealthy = any(
+        s.get("healthy") is False or s.get("shedding") for s in states.values()
+    )
+    body = {
+        "status": "degraded" if unhealthy else "ok",
+        "providers": states,
+    }
+    return (503 if unhealthy else 200), body
 
 
 class MetricsExporter:
@@ -101,7 +161,14 @@ def _make_handler(registry: MetricsRegistry):
                     body = json.dumps(chrome_trace(RECORDER.spans())).encode()
                     self._send(200, "application/json", body)
                 elif path == "/healthz":
-                    self._send(200, "text/plain", b"ok\n")
+                    status, health = health_snapshot()
+                    if health is None:
+                        self._send(200, "text/plain", b"ok\n")
+                    else:
+                        self._send(
+                            status, "application/json",
+                            json.dumps(health).encode(),
+                        )
                 else:
                     self._send(404, "text/plain", b"not found\n")
             except BrokenPipeError:

@@ -13,6 +13,7 @@ system-queue jobs.
 from __future__ import annotations
 
 import itertools
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -49,6 +50,29 @@ class FakeLichess:
     status_supported: bool = True
     abort_supported: bool = True
     require_key: bool = True
+    #: Saturating load generator: keep at least this many unacquired
+    #: system-queue analysis jobs in the queue at every acquire — the
+    #: queue never drains, which is what "4x saturating load" means for
+    #: the overload bench. 0 disables (default: finite queue as before).
+    auto_refill: int = 0
+    #: With auto_refill active, every Nth synthesized job is a best-move
+    #: job so the latency lane sees traffic during saturation. 0 = never.
+    refill_move_every: int = 0
+    #: Cap on total synthesized jobs, so a shedding client can't make the
+    #: generator spin forever. None = unbounded.
+    refill_limit: Optional[int] = None
+    refill_count: int = 0
+    #: Latency bookkeeping (monotonic clock): when a job was handed out
+    #: on acquire, when its first progress/analysis report arrived, when
+    #: the completed analysis landed, and when a move was submitted.
+    handed_at: Dict[str, float] = field(default_factory=dict)
+    first_report_at: Dict[str, float] = field(default_factory=dict)
+    completed_at: Dict[str, float] = field(default_factory=dict)
+    move_done_at: Dict[str, float] = field(default_factory=dict)
+    #: Generated work-id prefix. Override when one test (or soak phase)
+    #: runs several servers against one shared ledger: each server's
+    #: counter restarts at 0, so identical prefixes would collide.
+    work_id_prefix: str = "wk"
     _counter: itertools.count = field(default_factory=itertools.count)
 
     # -- job injection (test side) ---------------------------------------
@@ -66,7 +90,7 @@ class FakeLichess:
         user_queue: bool = False,
         work_id: Optional[str] = None,
     ) -> str:
-        work_id = work_id or f"wk{next(self._counter):06d}"
+        work_id = work_id or f"{self.work_id_prefix}{next(self._counter):06d}"
         work = {
             "type": "analysis",
             "id": work_id,
@@ -97,7 +121,7 @@ class FakeLichess:
         variant: str = "standard",
         work_id: Optional[str] = None,
     ) -> str:
-        work_id = work_id or f"wk{next(self._counter):06d}"
+        work_id = work_id or f"{self.work_id_prefix}{next(self._counter):06d}"
         work: dict = {"type": "move", "id": work_id, "level": level}
         if clock:
             work["clock"] = clock
@@ -110,6 +134,24 @@ class FakeLichess:
         }
         self.jobs.append(FakeJob(body=body, user_queue=False))
         return work_id
+
+    def _refill(self) -> None:
+        """Top the queue back up to ``auto_refill`` unacquired jobs."""
+        if self.auto_refill <= 0:
+            return
+        pending = sum(1 for j in self.jobs if j.acquired_by is None)
+        while pending < self.auto_refill:
+            if self.refill_limit is not None and self.refill_count >= self.refill_limit:
+                return
+            self.refill_count += 1
+            if (
+                self.refill_move_every > 0
+                and self.refill_count % self.refill_move_every == 0
+            ):
+                self.add_move_job()
+            else:
+                self.add_analysis_job()
+            pending += 1
 
     # -- handlers --------------------------------------------------------
 
@@ -131,9 +173,11 @@ class FakeLichess:
         if not self._check_auth(request, body):
             return web.Response(status=401, text="unknown key")
         slow = request.query.get("slow") == "true"
+        self._refill()
         for job in self.jobs:
             if job.acquired_by is None and not (slow and job.user_queue):
                 job.acquired_by = body.get("fishnet", {}).get("apikey", "?")
+                self.handed_at.setdefault(job.body["work"]["id"], time.monotonic())
                 return web.json_response(job.body, status=202)
         return web.Response(status=204)
 
@@ -143,6 +187,7 @@ class FakeLichess:
         if not self._check_auth(request, body):
             return web.Response(status=401)
         parts = body.get("analysis", [])
+        self.first_report_at.setdefault(work_id, time.monotonic())
         # Lila quirk: a report whose first part is null is a progress
         # report, not a completed analysis (reference src/queue.rs:686-697).
         if parts and parts[0] is None:
@@ -155,6 +200,7 @@ class FakeLichess:
                 self.analysis_submission_counts.get(work_id, 0) + 1
             )
             self.analyses[work_id] = body
+            self.completed_at.setdefault(work_id, time.monotonic())
             self.jobs = [j for j in self.jobs if j.body["work"]["id"] != work_id]
         return web.Response(status=204)
 
@@ -164,11 +210,13 @@ class FakeLichess:
         if not self._check_auth(request, body):
             return web.Response(status=401)
         self.moves[work_id] = body
+        self.move_done_at.setdefault(work_id, time.monotonic())
         self.jobs = [j for j in self.jobs if j.body["work"]["id"] != work_id]
         # Chained acquire (202 with next job) when available.
         for job in self.jobs:
             if job.acquired_by is None and job.body["work"]["type"] == "move":
                 job.acquired_by = "chained"
+                self.handed_at.setdefault(job.body["work"]["id"], time.monotonic())
                 return web.json_response(job.body, status=202)
         return web.Response(status=204)
 
