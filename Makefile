@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
@@ -54,6 +54,17 @@ coalesce-smoke:
 async-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_async_dispatch.py -q \
 		-k "xla or overlap or ping_pong or no_async_env"
+
+# Placement-aware mesh serving contract (doc/sharding.md, ≤60 s, 8
+# virtual devices): the mesh run must spread dispatches over more than
+# one shard with analyses bit-identical to the single-device path and
+# the exactly-once ledger clean; FISHNET_NO_MESH=1 restores the
+# single-device service byte-for-byte; a per-shard device fault
+# degrades ONLY its shard's ladder rung without changing output.
+multichip-smoke:
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PYTHON) -m pytest tests/test_parallel.py -q \
+		-k "mesh_serving_parity or ladder_isolation"
 
 # Causal-tracing contract (doc/observability.md "Causal tracing",
 # ≤60 s): a gated mock-server run must yield complete span trees (zero

@@ -89,31 +89,28 @@ def validate_mesh(opt: Opt) -> None:
 
 
 def build_sharded_evaluator(opt: Opt, weights, logger: Logger):
-    """The multi-chip serving tier: a ShardedEvaluator that splits every
-    eval microbatch over a device mesh (pure dp; params replicated).
-    Returns None when single-device serving is the right call — one
-    visible device, --mesh off, or a mesh that doesn't match the
-    hardware."""
+    """The LEGACY multi-chip tier: one ShardedEvaluator (shard_map) that
+    splits every eval microbatch over a single mesh-wide program. Only
+    built for an EXPLICIT --mesh DxM with model > 1 — a tensor-parallel
+    request the placement-aware serving mesh (per-shard placement,
+    doc/sharding.md) cannot express. "auto" and data-only meshes return
+    None: SearchService drives those per shard from the coalescer."""
     mesh_spec = opt.resolved_mesh()
-    if mesh_spec == "off":
+    if mesh_spec in ("off", "auto"):
         return None
     import jax
 
-    n = len(jax.devices())
-    if n < 2 and mesh_spec == "auto":
-        return None
+    validate_mesh(opt)
+    data, model = (int(x) for x in mesh_spec.split("x"))
+    if model <= 1:
+        return None  # data-only: the placement-aware path serves it
     from fishnet_tpu.nnue.jax_eval import params_from_weights
     from fishnet_tpu.parallel.mesh import ShardedEvaluator, make_mesh
 
-    validate_mesh(opt)
-    if mesh_spec == "auto":
-        mesh = make_mesh()
-    else:
-        data, model = (int(x) for x in mesh_spec.split("x"))
-        mesh = make_mesh(jax.devices()[: data * model], data=data, model=model)
+    mesh = make_mesh(jax.devices()[: data * model], data=data, model=model)
     logger.info(
         f"Sharding eval batches over a {mesh.devices.shape[0]}x"
-        f"{mesh.devices.shape[1]} device mesh."
+        f"{mesh.devices.shape[1]} device mesh (single fused program)."
     )
     return ShardedEvaluator(
         params_from_weights(weights),
@@ -122,14 +119,48 @@ def build_sharded_evaluator(opt: Opt, weights, logger: Logger):
     )
 
 
+def resolve_mesh_devices(opt: Opt, evaluator, logger: Logger):
+    """The placement-aware serving mesh request for SearchService
+    (doc/sharding.md): "auto" follows the visible devices, an explicit
+    data-only DxM pins the shard count, and anything served by the
+    legacy evaluator (or --mesh off) stays single-device. The service
+    itself degrades to the single-device path when fewer than two
+    devices remain (or FISHNET_NO_MESH=1)."""
+    mesh_spec = opt.resolved_mesh()
+    if evaluator is not None or mesh_spec == "off":
+        return None
+    if mesh_spec == "auto":
+        import jax
+
+        if len(jax.devices()) < 2:
+            return None
+        logger.info(
+            f"Placement-aware serving mesh over {len(jax.devices())} "
+            "devices (per-shard dispatch from the coalescer)."
+        )
+        return "auto"
+    validate_mesh(opt)
+    data, model = (int(x) for x in mesh_spec.split("x"))
+    n = data * model
+    if n < 2:
+        return None
+    logger.info(
+        f"Placement-aware serving mesh over {n} devices "
+        "(per-shard dispatch from the coalescer)."
+    )
+    return n
+
+
 def build_search_service(opt: Opt, logger: Logger, psqt_path=None):
     """The shared batched-search backend, from CLI options (dev-mode
     random weights when no --nnue-file is given). Without --pipeline the
     depth is probed for DEVICE dispatch overlap and floored at 2: even
     on fully serialized tunnels the host phase (fiber stepping, feature
     extraction) overlaps the other group's wire wait. With >1 visible
-    device (or an explicit --mesh) eval batches are sharded over a
-    device mesh instead of riding one chip. ``psqt_path`` requests a
+    device (or an explicit --mesh) the service drives the whole mesh
+    from the coalescer — per-shard placed dispatches, doc/sharding.md —
+    while an explicit model-parallel DxM falls back to the legacy
+    single-program ShardedEvaluator. ``psqt_path`` requests a
     rung of the eval-path lattice (the degradation ladder's seam,
     resilience/supervisor.py); None = auto-select."""
     from fishnet_tpu.nnue.weights import NnueWeights
@@ -142,6 +173,7 @@ def build_search_service(opt: Opt, logger: Logger, psqt_path=None):
         weights = NnueWeights.random(seed=0)
 
     evaluator = build_sharded_evaluator(opt, weights, logger)
+    mesh_devices = resolve_mesh_devices(opt, evaluator, logger)
 
     depth = opt.pipeline
     dispatch_probe = None
@@ -181,6 +213,7 @@ def build_search_service(opt: Opt, logger: Logger, psqt_path=None):
         batch_capacity=opt.resolved_microbatch(),
         pipeline_depth=depth,
         evaluator=evaluator,
+        mesh_devices=mesh_devices,
         driver_threads=opt.resolved_search_threads(),
         psqt_path=psqt_path,
         dispatch_probe=dispatch_probe,

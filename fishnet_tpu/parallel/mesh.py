@@ -24,7 +24,9 @@ there are no hand-written collectives anywhere in the framework.
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence, Tuple
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -32,6 +34,86 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+
+def serving_devices(requested=None) -> List[jax.Device]:
+    """Resolve the device list the placement-aware serving mesh drives
+    (doc/sharding.md). ``requested`` is ``None``/``"auto"`` (every
+    visible device), an int (the first N devices), or an explicit device
+    sequence. ``FISHNET_NO_MESH=1`` is the operational escape hatch: it
+    clamps any request to the first device, restoring the single-device
+    serving path byte-for-byte."""
+    if requested is None or requested == "auto":
+        devs = list(jax.devices())
+    elif isinstance(requested, int):
+        devs = list(jax.devices())[: max(1, requested)]
+    else:
+        devs = list(requested)
+    if os.environ.get("FISHNET_NO_MESH", "0") == "1":
+        devs = devs[:1]
+    return devs
+
+
+class ShardRouter:
+    """Deterministic pipeline-group -> mesh-slot assignment for the
+    placement-aware coalescer (doc/sharding.md).
+
+    Groups are assigned round-robin (group g -> shard g % n_shards), so
+    each driver thread's contiguous group range spreads over the mesh
+    and every shard sees traffic from the first step. The assignment is
+    pure function of (n_groups, n_shards) until a shard is ``drain``ed —
+    the per-shard degradation ladder's last resort — after which the
+    dead shard's groups move round-robin over the surviving shards,
+    again deterministically.
+
+    Thread safety: every driver thread reads ``shard_of`` per step while
+    a degrading sibling may be draining — all state is guarded by one
+    leaf lock (never held while calling out), the pattern the R4
+    cross-thread checker certifies (tests/analysis_fixtures).
+    """
+
+    def __init__(self, n_groups: int, n_shards: int) -> None:
+        if n_shards < 1 or n_groups < 1:
+            raise ValueError("need at least one group and one shard")
+        self.n_groups = n_groups
+        self.n_shards = n_shards
+        self._lock = threading.Lock()
+        self._alive = list(range(n_shards))
+        self._assign = {g: g % n_shards for g in range(n_groups)}
+
+    def shard_of(self, group: int) -> int:
+        with self._lock:
+            return self._assign[group]
+
+    def groups_of(self, shard: int) -> List[int]:
+        with self._lock:
+            return sorted(g for g, s in self._assign.items() if s == shard)
+
+    def group_count(self, shard: int) -> int:
+        with self._lock:
+            return sum(1 for s in self._assign.values() if s == shard)
+
+    def alive_shards(self) -> List[int]:
+        with self._lock:
+            return list(self._alive)
+
+    def drain(self, shard: int) -> Dict[int, int]:
+        """Mark ``shard`` dead and reassign its groups round-robin over
+        the surviving shards. Returns {group: new_shard} for the moved
+        groups. Raises RuntimeError when no shard would remain — the
+        caller escalates to the whole-service failure path."""
+        with self._lock:
+            if shard in self._alive:
+                if len(self._alive) == 1:
+                    raise RuntimeError("no alive shard left in the mesh")
+                self._alive.remove(shard)
+            moved = {}
+            drained = sorted(g for g, s in self._assign.items() if s == shard)
+            for i, g in enumerate(drained):
+                tgt = self._alive[i % len(self._alive)]
+                self._assign[g] = tgt
+                moved[g] = tgt
+            return moved
 
 
 def factor_mesh(n_devices: int, max_model: int = 2) -> Tuple[int, int]:
@@ -226,3 +308,98 @@ class ShardedEvaluator:
         if material is None:
             return self._fn(self.params, indices, buckets, parent)
         return self._fn_mat(self.params, indices, buckets, parent, material)
+
+
+class ShardedSegmentedEvaluator:
+    """shard_map over the packed-anchored SEGMENTED evaluator: the fused
+    coalescer wire (nnue/jax_eval.evaluate_packed_anchored_segmented)
+    as ONE mesh-wide program, segments sharded over the data axis with
+    each shard's persistent anchor/PSQT tables resident on that shard.
+
+    Segment-locality is what makes this collective-free: every
+    segment's parent codes are SEGMENT-LOCAL (in-batch refs and
+    persistent-anchor rows both rebase inside the segment —
+    ops/ft_gather.recode_segment_parents / derive_segment_offsets), so
+    a device holding segments [k, k+K/n) never reads another device's
+    rows or tables. tests/test_parallel.py asserts the compiled HLO
+    contains zero collectives, the same invariant the single-program
+    benchmark path proved for evaluate_packed in round 5.
+
+    Serving itself uses per-shard PLACEMENT (independent per-device
+    dispatches driven by SearchService's shard router) rather than this
+    one fused program — placement lets shards degrade, drain, and
+    pipeline independently, which one mesh-wide program cannot. This
+    class is the topology's reference semantics: sharded-vs-single
+    parity and the zero-collectives proof are pinned against it.
+
+    The XLA realization is pinned (``use_pallas=False``): inside
+    shard_map the fused Pallas kernel's interpreter fallback is not a
+    supported venue, and all rungs are bit-identical anyway.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        from jax.sharding import PartitionSpec
+
+        from fishnet_tpu.nnue.jax_eval import (
+            evaluate_packed_anchored_segmented,
+        )
+
+        try:
+            from jax import shard_map as _shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+        if mesh is None:
+            devs = devices if devices is not None else jax.devices()
+            mesh = make_mesh(devs, model=1)
+        self.mesh = mesh
+        self.n_devices = mesh.devices.size
+        seg = PartitionSpec(DATA_AXIS)
+        repl = PartitionSpec()
+
+        def local_mat(params, packed, buckets, parent, material,
+                      anchor_tabs, seg_rows, psqt_tabs):
+            return evaluate_packed_anchored_segmented(
+                params, packed, buckets, parent, material,
+                anchor_tabs, seg_rows, psqt_tabs, use_pallas=False,
+            )
+
+        def local_nomat(params, packed, buckets, parent,
+                        anchor_tabs, seg_rows, psqt_tabs):
+            return evaluate_packed_anchored_segmented(
+                params, packed, buckets, parent, None,
+                anchor_tabs, seg_rows, psqt_tabs, use_pallas=False,
+            )
+
+        self._fn_mat = jax.jit(
+            _shard_map(
+                local_mat, mesh=mesh,
+                in_specs=(repl, seg, seg, seg, seg, seg, seg, seg),
+                out_specs=(seg, seg, seg),
+            )
+        )
+        self._fn = jax.jit(
+            _shard_map(
+                local_nomat, mesh=mesh,
+                in_specs=(repl, seg, seg, seg, seg, seg, seg),
+                out_specs=(seg, seg, seg),
+            )
+        )
+
+    def __call__(self, params, packed, buckets, parent, material,
+                 anchor_tabs, seg_rows, psqt_tabs):
+        """Same contract as evaluate_packed_anchored_segmented; the
+        segment count K (= anchor_tabs.shape[0]) must divide evenly over
+        the mesh so each device owns whole segments."""
+        k = anchor_tabs.shape[0]
+        if k % self.n_devices:
+            raise ValueError(
+                f"segment count {k} does not divide over {self.n_devices} "
+                "devices — pad the dispatch to a whole-segment multiple"
+            )
+        if material is None:
+            return self._fn(params, packed, buckets, parent,
+                            anchor_tabs, seg_rows, psqt_tabs)
+        return self._fn_mat(params, packed, buckets, parent, material,
+                            anchor_tabs, seg_rows, psqt_tabs)

@@ -389,17 +389,47 @@ def _register_service_collector(svc: "SearchService") -> int:
             "fishnet_dispatches_total for the coalesce ratio).",
             counters.get("eval_steps", 0),
         ))
-        # Live dispatch-overlap ratio from the async pipeline: the
+        # Live dispatch-overlap ratio from the async pipeline(s): the
         # fraction of dispatch-busy wall time with >=2 dispatches in
         # flight (1.0 = every dispatch fully hidden behind another;
         # 0 = the synchronous loop, or no async pipeline at all).
-        pipe = service._async_pipe
+        # Aggregated over the per-shard pipelines on the serving mesh.
+        busy = dual = 0.0
+        for pipe in service._async_pipes:
+            with pipe._lock:
+                busy += pipe._busy_s
+                dual += pipe._dual_s
         fams.append(_telemetry.gauge_family(
             "fishnet_dispatch_overlap_ratio",
             "Fraction of dispatch-busy wall time with >=2 device "
             "dispatches in flight (async pipeline; 0 when synchronous).",
-            pipe.overlap_ratio() if pipe is not None else 0.0,
+            dual / busy if busy > 0 else 0.0,
         ))
+        # Per-shard serving-mesh families (doc/sharding.md): dispatch
+        # counts, live occupancy EMA, and the degradation-ladder rung
+        # index per mesh slot. A single-device service exports the same
+        # families with one shard="0" sample, so dashboards never need
+        # a mesh-vs-single special case.
+        rep = service.shard_report()
+        for s in range(rep["n_shards"]):
+            lbl = {"shard": str(s)}
+            fams.append(_telemetry.counter_family(
+                "fishnet_shard_dispatches_total",
+                "Device dispatches issued per serving-mesh shard.",
+                rep["dispatches"][s], labels=lbl,
+            ))
+            fams.append(_telemetry.gauge_family(
+                "fishnet_shard_occupancy",
+                "Per-shard occupancy EMA (real entries per microbatch) "
+                "feeding that shard's coalesce-width policy.",
+                rep["occupancy"][s], labels=lbl,
+            ))
+            fams.append(_telemetry.gauge_family(
+                "fishnet_shard_ladder_rung",
+                "Per-shard degradation-ladder rung index "
+                "(0=fused, 1=xla, 2=host-material; 3=drained/dead).",
+                rep["rung_index"][s], labels=lbl,
+            ))
         with service._lock:
             pending = sum(len(p) for p in service._pending)
             queued = sum(len(s) for s in service._submissions)
@@ -445,6 +475,21 @@ _COALESCE_ERRORS = _telemetry.REGISTRY.counter(
     "Coalesced-dispatch flushes that raised; the error is re-raised on "
     "every owning driver thread at resolve time (R5: counted, not "
     "swallowed).",
+)
+
+#: Per-shard degradation-ladder rungs (doc/sharding.md), mirrors
+#: resilience/supervisor.py RUNGS — the mesh path steps ONE shard down
+#: this ladder on a device_step fault instead of crashing the driver,
+#: so a sick chip never takes healthy shards with it. The supervisor's
+#: whole-service ladder remains the single-device recovery path.
+_MESH_RUNGS = ("fused", "xla", "host-material")
+
+_SHARD_DEGRADATIONS = _telemetry.REGISTRY.counter(
+    "fishnet_shard_degradations_total",
+    "Per-shard degradation-ladder steps on the serving mesh "
+    "(shard, from -> to rung; 'drained' as the to-rung means the shard "
+    "was marked dead and its groups moved to siblings).",
+    labelnames=("shard", "from", "to"),
 )
 
 
@@ -563,11 +608,25 @@ class _DispatchCoalescer:
         self._svc = svc
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._pending: List[_CoalesceTicket] = []
+        # PLACEMENT-AWARE pending state (doc/sharding.md): one parked
+        # list, occupancy EMA, and policy width PER MESH SHARD — a
+        # flush only ever fuses microbatches bound for one device, so
+        # every fused dispatch stays a single-device program and the
+        # shards pack/compute/decode concurrently. A single-device
+        # service has exactly one shard (index 0) and behaves
+        # byte-for-byte like the pre-mesh coalescer.
+        n_shards = getattr(svc, "_n_shards", 1)
+        self._n_shards = n_shards
+        self._pending: Dict[int, List[_CoalesceTicket]] = {
+            s: [] for s in range(n_shards)
+        }
         self._pinned = pinned_width
         self._probe: Optional[DispatchProbe] = None
-        self._occ_ema: Optional[float] = None
-        self.width = pinned_width if pinned_width is not None else 1
+        self._occ_ema: Dict[int, Optional[float]] = {
+            s: None for s in range(n_shards)
+        }
+        init_w = pinned_width if pinned_width is not None else 1
+        self._widths: Dict[int, int] = {s: init_w for s in range(n_shards)}
         self._linger_s = (
             self.MAX_LINGER_S
             if pinned_width is not None and pinned_width > 1 else 0.0
@@ -580,60 +639,100 @@ class _DispatchCoalescer:
         self.fused_dispatches = 0
         self.coalesced_steps = 0
         self.deduped_evals = 0
+        self.shard_dispatches = [0] * n_shards
+
+    @property
+    def width(self) -> int:
+        """The widest per-shard policy width — what _warm_segmented
+        compiles for (every shard's width is bounded by it)."""
+        return max(self._widths.values())
+
+    def _shard_of(self, group: int) -> int:
+        router = self._svc._router
+        return router.shard_of(group) if router is not None else 0
 
     def set_probe(self, probe: DispatchProbe) -> None:
         with self._lock:
             self._probe = probe
-            self._recompute_width()
+            for s in range(self._n_shards):
+                self._recompute_width(s)
 
-    def _recompute_width(self) -> None:
-        # Caller holds self._lock.
+    def _recompute_width(self, shard: int) -> None:
+        # Caller holds self._lock (the router's lock is a leaf — safe
+        # to take underneath).
         if self._pinned is not None:
-            self.width = max(1, min(self._pinned, self.MAX_WIDTH))
+            self._widths[shard] = max(1, min(self._pinned, self.MAX_WIDTH))
             return
         if self._probe is None:
             return  # width stays 1 until the warmup probe lands
-        slots = self._occ_ema if self._occ_ema is not None else 1.0
-        self.width = choose_coalesce_width(
-            self._probe.fixed_ms, self._probe.marginal_ms_per_kslot,
-            slots, self._svc._n_groups, cap=self.MAX_WIDTH,
+        slots = self._occ_ema[shard]
+        if slots is None:
+            slots = 1.0
+        # Width scales with the groups ROUTED TO THIS SHARD, not the
+        # global group count: with the mesh up, each shard can only
+        # ever fuse its own share of the pipeline groups.
+        router = self._svc._router
+        n_groups = (
+            router.group_count(shard) if router is not None
+            else self._svc._n_groups
         )
-        if self._svc.driver_threads > 1 and self.width > 1:
+        self._widths[shard] = choose_coalesce_width(
+            self._probe.fixed_ms, self._probe.marginal_ms_per_kslot,
+            slots, max(1, n_groups), cap=self.MAX_WIDTH,
+        )
+        if self._svc.driver_threads > 1 and self._widths[shard] > 1:
             self._linger_s = min(
                 self.MAX_LINGER_S, self._probe.fixed_ms / 1e3 / 16
             )
-        else:
-            self._linger_s = 0.0
 
     def submit(
         self, group: int, n: int, rows: int, trace=None
     ) -> _CoalesceTicket:
-        """Park a stepped group's microbatch; returns its ticket. May
-        flush (dispatch) on this thread if the policy width is reached.
-        ``trace`` (the owner's device_step context) must ride the
-        ticket from birth — the width trigger can flush inline before
-        the caller ever sees the ticket."""
+        """Park a stepped group's microbatch on its SHARD's pending
+        list; returns its ticket. May flush (dispatch) on this thread if
+        the shard's policy width is reached. ``trace`` (the owner's
+        device_step context) must ride the ticket from birth — the
+        width trigger can flush inline before the caller ever sees the
+        ticket."""
         ticket = _CoalesceTicket(group, n, rows, trace=trace)
+        s = self._shard_of(group)
         flush = None
         with self._lock:
-            ema = self._occ_ema
-            self._occ_ema = n if ema is None else 0.8 * ema + 0.2 * n
-            self._recompute_width()
-            self._pending.append(ticket)
-            if len(self._pending) >= self.width:
-                flush, self._pending = self._pending, []
+            ema = self._occ_ema[s]
+            self._occ_ema[s] = n if ema is None else 0.8 * ema + 0.2 * n
+            self._recompute_width(s)
+            self._pending[s].append(ticket)
+            if len(self._pending[s]) >= self._widths[s]:
+                flush, self._pending[s] = self._pending[s], []
             self._cond.notify_all()  # wake lingering demand()s
         if flush:
-            self._flush(flush)
+            self._flush(flush, s)
         return ticket
+
+    def migrate(self, moved: Dict[int, int]) -> None:
+        """Re-park pending tickets after a shard drain: every parked
+        ticket moves to its group's CURRENT shard so a demanded ticket
+        is always found on the list its owner will flush. Called by the
+        degradation path right after the router reassignment."""
+        router = self._svc._router
+        if router is None:
+            return
+        with self._lock:
+            parked = [tk for lst in self._pending.values() for tk in lst]
+            for s in self._pending:
+                self._pending[s] = []
+            for tk in parked:
+                self._pending[router.shard_of(tk.group)].append(tk)
+            self._cond.notify_all()
 
     def demand(self, ticket: _CoalesceTicket):
         """Block until ``ticket`` has been dispatched; returns its value
         slice. Called by the owning driver when it needs the result —
-        after a bounded linger for sibling threads' ready microbatches,
-        flushes everything parked (the ticket included, unless another
-        thread's flush already claimed it)."""
+        after a bounded linger for sibling threads' ready microbatches
+        ON THE SAME SHARD, flushes that shard's parked list (the ticket
+        included, unless another thread's flush already claimed it)."""
         if not ticket.done.is_set():
+            s = self._shard_of(ticket.group)
             # Lane-aware demand: the linger trades a sub-RTT delay for
             # fuller fused dispatches — a good trade for bulk analysis,
             # a bad one while an interactive best-move search is in
@@ -643,17 +742,23 @@ class _DispatchCoalescer:
                 deadline = time.monotonic() + self._linger_s
                 with self._cond:
                     while (
-                        ticket in self._pending
-                        and len(self._pending) < self.width
+                        ticket in self._pending[s]
+                        and len(self._pending[s]) < self._widths[s]
                     ):
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             break
                         self._cond.wait(remaining)
             with self._lock:
-                flush, self._pending = self._pending, []
+                # Flush the shard that actually holds the ticket: a
+                # drain may have migrated it while we lingered.
+                for sh, lst in self._pending.items():
+                    if ticket in lst:
+                        s = sh
+                        break
+                flush, self._pending[s] = self._pending[s], []
             if flush:
-                self._flush(flush)
+                self._flush(flush, s)
         ticket.done.wait()
         if ticket.error is not None:
             raise NativeCoreError(
@@ -665,18 +770,21 @@ class _DispatchCoalescer:
             return whole[ticket.start : ticket.start + ticket.seg_size]
         return values
 
-    def _flush(self, tickets: List[_CoalesceTicket]) -> None:
+    def _flush(self, tickets: List[_CoalesceTicket], shard: int = 0) -> None:
         """Dispatch a flush batch. With the async pipeline up this is
-        pure SCHEDULING — the batch is handed to the pack worker and
-        executes off the driver threads; synchronously (FISHNET_NO_ASYNC,
-        or a dead pipeline) it executes inline, exactly the PR 5 loop."""
-        pipe = self._svc._async_pipe
+        pure SCHEDULING — the batch is handed to ITS SHARD's pack worker
+        and executes off the driver threads; synchronously
+        (FISHNET_NO_ASYNC, or a dead pipeline) it executes inline,
+        exactly the PR 5 loop."""
+        pipes = self._svc._async_pipes
+        pipe = pipes[shard] if shard < len(pipes) else None
         if pipe is not None and pipe.submit(tickets):
             return
         self._execute(tickets)
 
     def _execute(self, tickets: List[_CoalesceTicket]) -> None:
         svc = self._svc
+        shard = self._shard_of(tickets[0].group)
         tel = _telemetry.enabled()
         t0 = time.monotonic() if tel else 0.0
         try:
@@ -695,6 +803,7 @@ class _DispatchCoalescer:
             return
         with self._lock:
             self.dispatches += 1
+            self.shard_dispatches[shard] += 1
             if len(tickets) > 1:
                 self.fused_dispatches += 1
                 self.coalesced_steps += len(tickets)
@@ -713,7 +822,25 @@ class _DispatchCoalescer:
                 width=len(tickets),
                 groups=[tk.group for tk in tickets],
                 n=sum(tk.n for tk in tickets),
+                shard=shard,
             )
+
+
+class _SeqAllocator:
+    """Mesh-global dispatch sequence numbers. With one async pipeline
+    per shard, seq must stay globally unique (bench.py pairs
+    dispatch_issue/dispatch_wait spans by it) while each pipe keeps its
+    own consecutive local counter for staging-slot indexing."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+
+    def __call__(self) -> int:
+        with self._lock:
+            seq = self._next
+            self._next += 1
+            return seq
 
 
 class _AsyncDispatchPipeline:
@@ -756,19 +883,31 @@ class _AsyncDispatchPipeline:
     #: Ping-pong double buffer: two dispatches in flight, no more.
     DEPTH = 2
 
-    def __init__(self, svc: "SearchService") -> None:
+    def __init__(self, svc: "SearchService", shard: int = 0,
+                 seq_alloc: Optional["_SeqAllocator"] = None) -> None:
         self._svc = svc
+        self._shard = shard
+        # Mesh mode runs ONE pipeline per shard, each with its own pack
+        # and decode workers, ping-pong slots, and overlap clock — so
+        # every device keeps DEPTH dispatches in flight independently.
+        # The dispatch sequence number stays GLOBAL across pipes (a
+        # shared allocator) so bench.py's issue/wait span pairing by
+        # seq stays unambiguous; the staging-slot index uses a
+        # PIPE-LOCAL counter (lseq) because only consecutive-per-pipe
+        # numbering keeps the slot ping-pong alternating.
+        self._seq_alloc = seq_alloc
         self._lock = threading.Lock()
         self._pack_q: "queue.Queue" = queue.Queue()
         self._decode_q: "queue.Queue" = queue.Queue()
         self._slots = threading.Semaphore(self.DEPTH)
-        # Staging-slot occupancy (index = seq % DEPTH): the pack worker
+        # Staging-slot occupancy (index = lseq % DEPTH): the pack worker
         # asserts a slot is free before staging into it. Releases are
         # FIFO (the decode worker materializes in dispatch order), so
         # the semaphore alone already guarantees this — the flags are
         # the donation-correctness guard the async tests pin.
         self._staging_inuse = [False] * self.DEPTH
         self._seq = 0
+        self._lseq = 0
         self._stopping = False
         self._dead: Optional[BaseException] = None
         # Overlap accounting (lock-guarded, two transitions per
@@ -780,11 +919,12 @@ class _AsyncDispatchPipeline:
         self._last_ts = 0.0
         self._busy_s = 0.0
         self._dual_s = 0.0
+        sfx = f"-s{shard}" if shard else ""
         self._pack_thread = threading.Thread(
-            target=self._pack_loop, name="dispatch-pack", daemon=True
+            target=self._pack_loop, name="dispatch-pack" + sfx, daemon=True
         )
         self._decode_thread = threading.Thread(
-            target=self._decode_loop, name="dispatch-decode", daemon=True
+            target=self._decode_loop, name="dispatch-decode" + sfx, daemon=True
         )
         self._pack_thread.start()
         self._decode_thread.start()
@@ -798,9 +938,14 @@ class _AsyncDispatchPipeline:
         with self._lock:
             if self._stopping or self._dead is not None:
                 return False
-            seq = self._seq
-            self._seq += 1
-        self._pack_q.put((seq, tickets))
+            if self._seq_alloc is not None:
+                seq = self._seq_alloc()
+            else:
+                seq = self._seq
+                self._seq += 1
+            lseq = self._lseq
+            self._lseq += 1
+        self._pack_q.put((seq, lseq, tickets))
         return True
 
     def queue_depth(self) -> int:
@@ -861,7 +1006,7 @@ class _AsyncDispatchPipeline:
                     break
                 if item is None:
                     continue
-                for tk in item[1]:
+                for tk in item[2]:
                     if not tk.done.is_set():
                         tk.error = err
                         tk.done.set()
@@ -872,9 +1017,9 @@ class _AsyncDispatchPipeline:
             item = self._pack_q.get()
             if item is None:
                 return
-            seq, tickets = item
+            seq, lseq, tickets = item
             self._slots.acquire()  # wait for a free ping-pong slot
-            slot = seq % self.DEPTH
+            slot = lseq % self.DEPTH
             with self._lock:
                 staging_free = not self._staging_inuse[slot]
                 self._staging_inuse[slot] = True
@@ -929,15 +1074,16 @@ class _AsyncDispatchPipeline:
                     "dispatch_issue", t0, trace=issue_ctx, links=links,
                     seq=seq, width=len(tickets),
                     n=sum(tk.n for tk in tickets),
+                    shard=self._shard,
                 )
-            self._decode_q.put((seq, tickets, issue_ctx, links))
+            self._decode_q.put((seq, lseq, tickets, issue_ctx, links))
 
     def _decode_loop(self) -> None:
         while True:
             item = self._decode_q.get()
             if item is None:
                 return
-            seq, tickets, issue_ctx, links = item
+            seq, lseq, tickets, issue_ctx, links = item
             tel = _telemetry.enabled()
             t0 = time.monotonic() if tel else 0.0
             try:
@@ -953,12 +1099,13 @@ class _AsyncDispatchPipeline:
                 # as a driver crash), so nothing is swallowed.
                 _COALESCE_ERRORS.inc()
             self._mark(-1)
-            self._release(seq % self.DEPTH)
+            self._release(lseq % self.DEPTH)
             if tel:
                 _SPANS.record(
                     "dispatch_wait", t0,
                     trace=issue_ctx.child() if issue_ctx else None,
                     links=links, seq=seq, width=len(tickets),
+                    shard=self._shard,
                 )
 
 
@@ -986,6 +1133,7 @@ class SearchService:
         driver_threads: int = 1,
         psqt_path: Optional[str] = None,
         dispatch_probe: Optional[DispatchProbe] = None,
+        mesh_devices=None,
     ) -> None:
         """``evaluator``: optional callable ``(params, indices, buckets) ->
         int32 [B]`` replacing the built-in single-device
@@ -1008,7 +1156,21 @@ class SearchService:
         ``dispatch_probe``: a pre-measured DispatchProbe (e.g. from
         ``suggest_pipeline_depth(..., return_probe=True)``) seeding the
         dispatch coalescer's width policy; None = the service probes
-        its own eval path during warmup."""
+        its own eval path during warmup.
+
+        ``mesh_devices``: opt into PLACEMENT-AWARE sharded serving
+        (doc/sharding.md). ``None`` (default) keeps today's
+        single-device path byte-for-byte; ``"auto"`` takes every
+        visible device; an int takes the first N; a sequence of
+        ``jax.Device`` uses exactly those. Each mesh shard is one
+        device holding its own replica of the network params and the
+        persistent anchor/PSQT tables of the pipeline groups routed to
+        it — dispatches are plain single-device programs placed by
+        committed inputs, so the zero-collectives invariant holds per
+        shard by construction. Requires the builtin packed-wire
+        evaluator and >1 pipeline group (the coalescer is the router's
+        substrate); ``FISHNET_NO_MESH=1`` clamps any request back to
+        one device."""
         if psqt_path not in (None, "fused", "xla", "host-material"):
             raise ValueError(f"unknown psqt_path request: {psqt_path!r}")
         self._lib = load()
@@ -1261,6 +1423,89 @@ class SearchService:
             self._eval_fn = functools.partial(
                 self._eval_fn, use_pallas=up, interpret=interp
             )
+        # PLACEMENT-AWARE SERVING MESH (doc/sharding.md): opt-in via
+        # mesh_devices. Each shard is ONE device with its own params
+        # replica; the groups routed to a shard keep their donated
+        # anchor/PSQT tables resident there, so every dispatch is a
+        # single-device program placed by its committed inputs —
+        # shard-local delta/parent resolution, zero collectives, and
+        # the shards' pipelines overlap freely. None (or
+        # FISHNET_NO_MESH=1, or one visible device) leaves every mesh
+        # field at its single-device default: the pre-mesh code path
+        # byte-for-byte.
+        coalesce_on = (
+            self._packed_wire and self._n_groups > 1
+            and os.environ.get("FISHNET_NO_COALESCE", "0") != "1"
+        )
+        self._router = None
+        self._n_shards = 1
+        self._shard_devices = None
+        self._shard_params = None
+        self._rung_fns = None
+        self._mesh_lock = None
+        self._rung0 = (
+            _MESH_RUNGS.index(self.psqt_path) if self._packed_wire else 2
+        )
+        self._shard_rungs = [self._rung0]
+        if coalesce_on and mesh_devices is not None:
+            import functools
+
+            import jax
+
+            from fishnet_tpu.nnue.jax_eval import (
+                evaluate_packed_anchored_jit as _eval_jit,
+                evaluate_packed_anchored_segmented_jit as _seg_jit,
+            )
+            from fishnet_tpu.parallel.mesh import ShardRouter, serving_devices
+
+            devs = serving_devices(mesh_devices)
+            if len(devs) > 1:
+                self._n_shards = min(len(devs), self._n_groups)
+                devs = devs[: self._n_shards]
+                self._shard_devices = devs
+                self._router = ShardRouter(self._n_groups, self._n_shards)
+                self._mesh_lock = threading.Lock()
+                self._shard_rungs = [self._rung0] * self._n_shards
+                # Per-shard params replicas: shard 0 keeps self._params
+                # (the single-device object — byte-identical when every
+                # group routes there), shards 1.. get a copy committed
+                # to their device so jit placement follows the inputs.
+                self._shard_params = [self._params] + [
+                    jax.device_put(self._params, d) for d in devs[1:]
+                ]
+                # Initial table placement: each group's donated
+                # anchor/PSQT tables start on its shard's device (no
+                # dispatch is in flight yet, so eager moves are safe;
+                # after a drain, _place_group_tables migrates lazily).
+                for g in range(self._n_groups):
+                    d = devs[self._router.shard_of(g)]
+                    self._anchor_tabs[g] = jax.device_put(
+                        self._anchor_tabs[g], d
+                    )
+                    self._psqt_tabs[g] = jax.device_put(self._psqt_tabs[g], d)
+                # The per-shard degradation ladder's eval functions,
+                # rung -> (eval_fn, segmented_fn) with the executor
+                # pinned per rung. Rung 0 (the service's configured
+                # path) is special-cased in _eval_state to read
+                # self._eval_fn/_segmented_fn AT CALL TIME so test and
+                # bench monkeypatches keep working.
+                on_tpu = (
+                    jax.default_backend() == "tpu" and spec.L1 % 1024 == 0
+                )
+                fused_pin = (True, False) if on_tpu else (False, True)
+                self._rung_fns = {}
+                for rung, pin in (
+                    (0, fused_pin), (1, (False, False)), (2, (False, False))
+                ):
+                    up, interp = pin
+                    self._rung_fns[rung] = (
+                        functools.partial(
+                            _eval_jit, use_pallas=up, interpret=interp
+                        ),
+                        functools.partial(
+                            _seg_jit, use_pallas=up, interpret=interp
+                        ),
+                    )
         # DISPATCH COALESCER: when several pipeline groups have
         # microbatches ready, fuse them into ONE segmented device
         # dispatch (evaluate_packed_anchored_segmented) instead of
@@ -1275,10 +1520,7 @@ class SearchService:
         self._coalescer = None
         self._segmented_fn = None
         self.dispatch_probe = dispatch_probe
-        if (
-            self._packed_wire and self._n_groups > 1
-            and os.environ.get("FISHNET_NO_COALESCE", "0") != "1"
-        ):
+        if coalesce_on:
             import functools
 
             from fishnet_tpu.nnue.jax_eval import (
@@ -1306,7 +1548,7 @@ class SearchService:
         # without a coalescer there is nothing to pipeline (the per-
         # group inflight dict already overlaps at the JAX level).
         # FISHNET_NO_DEDUP=1 turns off cross-segment eval-dedup.
-        self._async_pipe = None
+        self._async_pipes: List[_AsyncDispatchPipeline] = []
         self._dedup_fused = (
             os.environ.get("FISHNET_NO_DEDUP", "0") != "1"
         )
@@ -1314,7 +1556,21 @@ class SearchService:
             self._coalescer is not None
             and os.environ.get("FISHNET_NO_ASYNC", "0") != "1"
         ):
-            self._async_pipe = _AsyncDispatchPipeline(self)
+            if self._n_shards > 1:
+                # One pipeline PER SHARD: every device keeps DEPTH
+                # dispatches in flight while its siblings pack, compute
+                # and decode concurrently. Seq numbers stay mesh-global
+                # (span pairing), slot indices pipe-local (ping-pong).
+                alloc = _SeqAllocator()
+                self._async_pipes = [
+                    _AsyncDispatchPipeline(self, shard=s, seq_alloc=alloc)
+                    for s in range(self._n_shards)
+                ]
+            else:
+                self._async_pipes = [_AsyncDispatchPipeline(self)]
+        # Kept as an attribute (not a property) for the async tests and
+        # bench, which address "the" pipeline on single-shard services.
+        self._async_pipe = self._async_pipes[0] if self._async_pipes else None
         self._packed_buf = np.empty((k, 4 * cap + 4, 2, 8), dtype=np.uint16)
         self._offset_buf = np.empty((k, cap), dtype=np.int32)
         self._bucket_buf = np.empty((k, cap), dtype=np.int32)
@@ -1326,8 +1582,14 @@ class SearchService:
         # cpp fill_full/fill_delta): only allocated when it actually
         # rides the wire — the device-psqt hot path passes a NULL
         # material pointer to fc_pool_step (optional since ABI 9).
+        # With the mesh up the buffer exists even on the device-psqt
+        # path: a shard degraded to the host-material rung needs the
+        # pool's material term on its wire while healthy shards ignore
+        # it (_eval_state's ship_material flag gates actual shipping).
         self._material_buf = (
-            None if self._device_psqt else np.empty((k, cap), dtype=np.int32)
+            None
+            if (self._device_psqt and self._router is None)
+            else np.empty((k, cap), dtype=np.int32)
         )
         # Per-thread state: each driver thread owns one cell of each
         # list, so the hot paths touch no shared structure (the shared
@@ -1534,6 +1796,8 @@ class SearchService:
                                 self._params, feats, bucks, parents, material
                             )
                         )
+            if self._router is not None and not self._stopping:
+                self._warm_shards()
             if self._coalescer is not None and not self._stopping:
                 # Seed the width policy: measure this eval path's
                 # fixed-vs-marginal dispatch cost (unless the caller
@@ -1622,6 +1886,37 @@ class SearchService:
                 )
                 np.asarray(values)
 
+    def _warm_shards(self) -> None:
+        """One compile per NON-PRIMARY shard (the main warmup loop
+        already covered shard 0's buckets): the largest bucket at its
+        first row tier, dispatched through each shard's first group so
+        the executable lands on that shard's device. Remaining shapes
+        compile lazily — warming every (bucket, tier) on every shard
+        would multiply startup cost by the mesh size."""
+        size = self._eval_sizes[-1]
+        tier = self._row_tiers(size)[0]
+        for s in range(1, self._n_shards):
+            if self._stopping:
+                return
+            groups = self._router.groups_of(s)
+            if not groups:
+                continue
+            g = groups[0]
+            params, eval_fn, _, ship_material, dev = self._eval_state(g)
+            packed = np.full((tier, 2, 8), spec.NUM_FEATURES, np.uint16)
+            bucks = np.zeros((size,), np.int32)
+            parents = np.full((size,), -1, np.int32)
+            material = (
+                np.zeros((size,), np.int32) if ship_material else None
+            )
+            self._place_group_tables(g, dev)
+            values, self._anchor_tabs[g], self._psqt_tabs[g] = eval_fn(
+                params, packed, bucks, parents, material,
+                self._anchor_tabs[g], np.zeros((1,), np.int32),
+                self._psqt_tabs[g],
+            )
+            np.asarray(values)
+
     def poke(self) -> None:
         """Wake the drivers (after setting a search's stop_event). Also
         applies set stop_events directly: the native per-slot stop flags
@@ -1699,20 +1994,18 @@ class SearchService:
         # dispatch count, queue depth in front of the workers, and the
         # busy/dual integrals behind the overlap-ratio gauge (exported
         # in microseconds so the dict stays int-valued).
-        pipe = self._async_pipe
-        if pipe is not None:
-            out["inflight_dispatches"] = pipe.inflight()
-            out["async_ready_queue"] = pipe.queue_depth()
-            out["decode_queue"] = pipe.decode_queue_depth()
+        out["inflight_dispatches"] = 0
+        out["async_ready_queue"] = 0
+        out["decode_queue"] = 0
+        out["overlap_busy_us"] = 0
+        out["overlap_dual_us"] = 0
+        for pipe in self._async_pipes:
+            out["inflight_dispatches"] += pipe.inflight()
+            out["async_ready_queue"] += pipe.queue_depth()
+            out["decode_queue"] += pipe.decode_queue_depth()
             with pipe._lock:
-                out["overlap_busy_us"] = int(pipe._busy_s * 1e6)
-                out["overlap_dual_us"] = int(pipe._dual_s * 1e6)
-        else:
-            out["inflight_dispatches"] = 0
-            out["async_ready_queue"] = 0
-            out["decode_queue"] = 0
-            out["overlap_busy_us"] = 0
-            out["overlap_dual_us"] = 0
+                out["overlap_busy_us"] += int(pipe._busy_s * 1e6)
+                out["overlap_dual_us"] += int(pipe._dual_s * 1e6)
         return out
 
     def is_alive(self) -> bool:
@@ -1762,8 +2055,8 @@ class SearchService:
         # Stop the async pack/decode workers AFTER the drivers are
         # drained: a driver blocked in demand() needs the pack worker
         # alive to set its ticket done.
-        if self._async_pipe is not None:
-            self._async_pipe.close()
+        for pipe in self._async_pipes:
+            pipe.close()
         if _telemetry.enabled():
             # Clean-close flight-recorder dump (doc/observability.md).
             _SPANS.dump(reason="close")
@@ -1796,6 +2089,139 @@ class SearchService:
         self._bucket_slots[t] += size
         self._wire_feature_bytes[t] += feature_bytes
         self._wire_material_bytes[t] += material_bytes
+
+    # -- placement-aware mesh plumbing (doc/sharding.md) -------------------
+
+    def _eval_state(self, group: int):
+        """The dispatch tuple for ``group``'s CURRENT placement:
+        ``(params, eval_fn, segmented_fn, ship_material, device)``.
+
+        Single-device services return the classic attributes with a
+        None device — byte-for-byte the pre-mesh path. On the mesh, the
+        group's shard picks its params replica, its ladder rung picks
+        the executor pinning, and ship_material says whether the pool's
+        material term rides this shard's wire (always on the
+        host-material rung, never on a healthy device-psqt shard). Rung
+        0 — the service's configured path — reads self._eval_fn /
+        self._segmented_fn AT CALL TIME so monkeypatched test doubles
+        and bench capture hooks keep intercepting mesh dispatches."""
+        if self._router is None:
+            return (
+                self._params, self._eval_fn, self._segmented_fn,
+                self._material_buf is not None, None,
+            )
+        shard = self._router.shard_of(group)
+        rung = self._shard_rungs[shard]
+        if rung == self._rung0:
+            eval_fn, seg_fn = self._eval_fn, self._segmented_fn
+        else:
+            eval_fn, seg_fn = self._rung_fns[rung]
+        ship = (not self._device_psqt) or rung == len(_MESH_RUNGS) - 1
+        return (
+            self._shard_params[shard], eval_fn, seg_fn, ship,
+            self._shard_devices[shard],
+        )
+
+    def _place_group_tables(self, group: int, dev) -> None:
+        """Lazily migrate ``group``'s donated anchor/PSQT tables to
+        ``dev`` — a no-op unless a drain re-routed the group to another
+        shard. Runs at DISPATCH time on the thread about to consume the
+        tables: the group's eval chain serializes every access, so the
+        move can never race an in-flight donation rebind."""
+        if dev is None:
+            return
+        import jax
+
+        tab = self._anchor_tabs[group]
+        if next(iter(tab.devices())) != dev:
+            with self._mesh_lock:
+                self._anchor_tabs[group] = jax.device_put(tab, dev)
+                self._psqt_tabs[group] = jax.device_put(
+                    self._psqt_tabs[group], dev
+                )
+
+    def _degrade_shard_for(self, group: int, err: BaseException) -> None:
+        """Per-shard degradation-ladder step after a device fault on
+        ``group``'s shard: fused -> xla -> host-material, then DRAIN —
+        mark the shard dead and re-route its groups round-robin over
+        the surviving shards (their tables migrate lazily at next
+        dispatch). Healthy shards are never touched. Raises ``err``
+        when no shard is left to drain to."""
+        shard = self._router.shard_of(group)
+        with self._mesh_lock:
+            rung = self._shard_rungs[shard]
+            if rung < len(_MESH_RUNGS) - 1:
+                self._shard_rungs[shard] = rung + 1
+                _SHARD_DEGRADATIONS.inc(**{
+                    "shard": str(shard),
+                    "from": _MESH_RUNGS[rung],
+                    "to": _MESH_RUNGS[rung + 1],
+                })
+                return
+            try:
+                moved = self._router.drain(shard)
+            except RuntimeError:
+                # Nowhere left to go: the whole mesh is sick. The
+                # original fault propagates as a driver crash.
+                raise err
+            self._coalescer.migrate(moved)
+            _SHARD_DEGRADATIONS.inc(**{
+                "shard": str(shard),
+                "from": _MESH_RUNGS[rung],
+                "to": "drained",
+            })
+
+    def shard_report(self):
+        """Per-shard serving snapshot for telemetry and bench: dispatch
+        counts, occupancy EMA, ladder rungs, liveness, and group
+        routing. Single-device services report one healthy shard so the
+        collector emits the same families either way."""
+        co = self._coalescer
+        if self._router is None:
+            dispatches = [co.shard_dispatches[0]] if co else (
+                [sum(self._eval_steps)]
+            )
+            occ = 0.0
+            if co is not None:
+                with co._lock:
+                    dispatches = [co.shard_dispatches[0]]
+                    ema = co._occ_ema.get(0)
+                    occ = float(ema) if ema is not None else 0.0
+            return {
+                "n_shards": 1,
+                "dispatches": dispatches,
+                "occupancy": [occ],
+                "rungs": [self.psqt_path],
+                "rung_index": [_MESH_RUNGS.index(self.psqt_path)],
+                "alive": [True],
+                "groups": [list(range(self._n_groups))],
+            }
+        with co._lock:
+            dispatches = list(co.shard_dispatches)
+            occ = [
+                float(co._occ_ema[s]) if co._occ_ema[s] is not None else 0.0
+                for s in range(self._n_shards)
+            ]
+        alive = set(self._router.alive_shards())
+        with self._mesh_lock:
+            rung_idx = [
+                self._shard_rungs[s] if s in alive else len(_MESH_RUNGS)
+                for s in range(self._n_shards)
+            ]
+        return {
+            "n_shards": self._n_shards,
+            "dispatches": dispatches,
+            "occupancy": occ,
+            "rungs": [
+                _MESH_RUNGS[i] if i < len(_MESH_RUNGS) else "drained"
+                for i in rung_idx
+            ],
+            "rung_index": rung_idx,
+            "alive": [s in alive for s in range(self._n_shards)],
+            "groups": [
+                self._router.groups_of(s) for s in range(self._n_shards)
+            ],
+        }
 
     def _dispatch_eval(self, group: int, n: int, rows: int):
         """Launch group `group`'s microbatch on the device WITHOUT waiting
@@ -1839,6 +2265,13 @@ class SearchService:
                 if rows + 4 <= rt:
                     tier = rt
                     break
+            # Placement: the group's shard supplies the params replica,
+            # rung executor, and material policy (single-device: the
+            # classic attributes, device None).
+            params, eval_fn, _, ship_material, dev = self._eval_state(group)
+            wire_material = (
+                material if (material is not None and ship_material) else None
+            )
             # Row offsets are derived ON DEVICE by cumsum over the
             # parent codes (4 rows per full, 1 per delta); the emitted
             # row count ships as a 4-byte scalar and padding entries
@@ -1850,13 +2283,14 @@ class SearchService:
             acct = (
                 size,
                 tier * 2 * 8 * 2 + size * 2 * 4 + 4,
-                0 if material is None else size * 4,
+                0 if wire_material is None else size * 4,
             )
+            self._place_group_tables(group, dev)
             values, self._anchor_tabs[group], self._psqt_tabs[group] = (
-                self._eval_fn(
-                    self._params, packed[:tier], buckets[:size],
+                eval_fn(
+                    params, packed[:tier], buckets[:size],
                     parents[:size],
-                    None if material is None else material[:size],
+                    None if wire_material is None else wire_material[:size],
                     self._anchor_tabs[group], np.array([rows], np.int32),
                     self._psqt_tabs[group],
                 )
@@ -1944,6 +2378,13 @@ class SearchService:
             if max(tk.n for tk in tickets) <= s:
                 size = s
                 break
+        # Placement: a fused flush only ever contains one shard's
+        # groups (the coalescer parks per shard), so tickets[0] decides
+        # the replica, rung executor, and material policy for the batch.
+        params, _, seg_fn, ship_material, dev = self._eval_state(
+            tickets[0].group
+        )
+        ship_material = ship_material and self._material_buf is not None
         # CROSS-SEGMENT EVAL-DEDUP (wire diet): identical plain-full
         # entries across the fused dispatch's segments ship once; each
         # duplicate is re-encoded as a one-row sentinel in-batch delta
@@ -1964,7 +2405,7 @@ class SearchService:
                 [self._offset_buf[tk.group] for tk in tickets],
                 [tk.n for tk in tickets],
                 [self._packed_buf[tk.group] for tk in tickets],
-                None if self._material_buf is None else
+                None if not ship_material else
                 [self._material_buf[tk.group] for tk in tickets],
             )
             if pairs:
@@ -1985,7 +2426,7 @@ class SearchService:
                 tier = rt
                 break
         material_cat = None
-        if self._material_buf is not None:
+        if ship_material:
             material_cat = np.empty((len(tickets), size), np.int32)
         for k, tk in enumerate(tickets):
             g, n, rows = tk.group, tk.n, tk.rows
@@ -2047,10 +2488,12 @@ class SearchService:
         # the trade this layer makes to pay ONE fixed transport cost.
         import jax.numpy as jnp
 
+        for tk in tickets:
+            self._place_group_tables(tk.group, dev)
         stacked = jnp.stack([self._anchor_tabs[tk.group] for tk in tickets])
         pstacked = jnp.stack([self._psqt_tabs[tk.group] for tk in tickets])
-        values, new_tabs, new_ptabs = self._segmented_fn(
-            self._params, packed_cat, buckets_cat, parents_cat,
+        values, new_tabs, new_ptabs = seg_fn(
+            params, packed_cat, buckets_cat, parents_cat,
             None if material_cat is None else material_cat.reshape(-1),
             stacked, seg_rows, pstacked,
         )
@@ -2062,8 +2505,12 @@ class SearchService:
         shared = _FusedValues(values, dups=dups_flat)
         for k, tk in enumerate(tickets):
             g = tk.group
-            self._anchor_tabs[g] = new_tabs[k]
-            self._psqt_tabs[g] = new_ptabs[k]
+            # Donation rebind: index g is only ever touched by the
+            # context currently driving group g (one ticket per group,
+            # flushed exactly once), so the per-group chain serializes
+            # every access without a lock.
+            self._anchor_tabs[g] = new_tabs[k]  # fishnet: ignore[R4] -- per-group eval chain serializes index g
+            self._psqt_tabs[g] = new_ptabs[k]  # fishnet: ignore[R4] -- per-group eval chain serializes index g
             tk.values = shared
             tk.start = k * size
             tk.seg_size = size
@@ -2297,8 +2744,23 @@ class SearchService:
                     # error/crash takes this driver down exactly like a
                     # real dispatch failure would — the supervisor's
                     # respawn + degradation ladder is the recovery.
+                    # MESH MODE localizes a plain injected error to the
+                    # group's SHARD instead: its per-shard ladder steps
+                    # (fused -> xla -> host-material -> drain) and the
+                    # step is then dispatched normally on the degraded
+                    # path — siblings never notice, the ledger stays
+                    # exactly-once. A FaultCrash (process-death drill)
+                    # still takes the driver down even on the mesh.
                     if _faults.enabled():
-                        _faults.fire("service.device_step")
+                        if self._router is None:
+                            _faults.fire("service.device_step")
+                        else:
+                            try:
+                                _faults.fire("service.device_step")
+                            except _faults.FaultCrash:
+                                raise
+                            except _faults.FaultInjected as err:
+                                self._degrade_shard_for(g, err)
                     t0 = time.monotonic() if tel else 0.0
                     dctx = step_ctx.child() if step_ctx is not None else None
                     if self._coalescer is not None:

@@ -93,3 +93,40 @@ class LeakyPipeline:
     async def submit(self, batch):
         with self._lock:
             self._seq += 1
+
+
+class ShardRouterPattern:
+    """The placement-aware serving shape (parallel/mesh.ShardRouter +
+    search/service per-shard pipelines): driver threads step a shard's
+    ladder rung and re-route groups under ONE leaf lock while async
+    submitters consult the same assignment map. Must be clean."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._assign = {0: 0}
+        self._rungs = [0, 0]
+        self._drive = threading.Thread(target=self._drive_loop)
+
+    def _drive_loop(self):
+        with self._lock:
+            self._rungs[0] += 1  # guarded ladder step: fine
+            self._assign[0] = 1  # guarded drain re-route: fine
+
+    async def route(self, group):
+        with self._lock:
+            self._assign[group] = self._assign.get(group, 0)
+            return self._assign[group]
+
+
+class LeakyShardRouter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rungs = [0, 0]
+        self._drive = threading.Thread(target=self._drive_loop)
+
+    def _drive_loop(self):
+        self._rungs[0] += 1  # VIOLATION: unguarded vs degrade's bump
+
+    async def degrade(self):
+        with self._lock:
+            self._rungs[0] += 1

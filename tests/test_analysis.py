@@ -135,6 +135,7 @@ def test_r4_fires_on_known_lines():
         ("R4", 11),  # module global from thread + async
         ("R4", 32),  # self._stopping unguarded in driver thread
         ("R4", 91),  # LeakyPipeline._seq unguarded in pack worker
+        ("R4", 128),  # LeakyShardRouter._rungs unguarded ladder step
     ]
 
 
@@ -157,6 +158,22 @@ def test_r4_pack_decode_handoff_pattern():
     assert not any("_inflight" in f.message for f in findings)
     assert not any("_ready" in f.message for f in findings)
     assert any("_seq" in f.message for f in findings)
+
+
+def test_r4_shard_router_pattern():
+    """The placement-aware serving shape (shard router + per-shard
+    pipelines): lock-guarded ladder steps and drain re-routes shared
+    between driver threads and async submitters are clean; the same
+    shape with an unguarded thread-side rung bump is flagged."""
+    findings = check_paths(
+        [FIXTURES / "r4_cross_thread.py"], [CrossThreadStateRule()]
+    )
+    assert not any("ShardRouterPattern" in f.message for f in findings)
+    assert not any("_assign" in f.message for f in findings)
+    assert any(
+        "LeakyShardRouter" in f.message and "_rungs" in f.message
+        for f in findings
+    )
 
 
 # -- R5 -------------------------------------------------------------------
