@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
@@ -65,6 +65,17 @@ multichip-smoke:
 	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PYTHON) -m pytest tests/test_parallel.py -q \
 		-k "mesh_serving_parity or ladder_isolation"
+
+# Position-keyed eval reuse contract (doc/eval-cache.md, ≤60 s subset
+# of tests/test_eval_cache.py): cache-off vs cache-cold vs cache-warm
+# analyses bit-identical on each single-device rung (warm = fresh
+# service against the surviving process cache), with warm runs
+# answering pre-wire and skipping device dispatches. The full file —
+# mesh parity, fault-plan ledger audit, cross-group dedup fan-out,
+# telemetry families, EvalCache units — runs in tier-1.
+cache-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_eval_cache.py -q \
+		-k "parity and not mesh"
 
 # Causal-tracing contract (doc/observability.md "Causal tracing",
 # ≤60 s): a gated mock-server run must yield complete span trees (zero

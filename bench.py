@@ -706,6 +706,21 @@ SUMMARY_SCHEMA = {
         "dispatches", "shard_dispatches", "shard_occupancy", "seconds",
         "nodes",
     ),
+    # --cache-replay mode (keyed by mode == "cache_replay"): position-
+    # keyed eval reuse — the same workload run with the cache off, cold
+    # and warm (warm = a fresh service against the surviving process
+    # cache, the supervisor-respawn shape). Headline: warm-over-cold
+    # device dispatch reduction, with three-way bit parity and the
+    # exactly-once ledger (doc/eval-cache.md).
+    "cache_replay": (
+        "metric", "value", "unit", "mode", "nodes", "positions",
+        "off", "cold", "warm", "parity", "ledger", "cache",
+    ),
+    "cache_replay.phase": (
+        "dispatches", "eval_steps", "nodes", "nodes_per_eval",
+        "eval_cache_hit_rate", "position_dedup_per_dispatch",
+        "prewire_hits", "skipped_dispatches", "seconds",
+    ),
     "overload.latency": (
         "move_p50_ms", "move_p99_ms", "move_n", "move_p99_budget_ms",
         "move_within_budget", "analysis_first_p50_ms",
@@ -729,6 +744,20 @@ def validate_summary(summary: dict) -> None:
             missing += [
                 f"tiers[{i}].{k}"
                 for k in SUMMARY_SCHEMA["multichip.tier"] if k not in tier
+            ]
+        if missing:
+            raise ValueError(f"bench summary missing keys: {missing}")
+        return
+    if summary.get("mode") == "cache_replay":
+        missing = [
+            k for k in SUMMARY_SCHEMA["cache_replay"] if k not in summary
+        ]
+        for ph in ("off", "cold", "warm"):
+            sub = summary.get(ph, {})
+            missing += [
+                f"{ph}.{k}"
+                for k in SUMMARY_SCHEMA["cache_replay.phase"]
+                if k not in sub
             ]
         if missing:
             raise ValueError(f"bench summary missing keys: {missing}")
@@ -1169,6 +1198,155 @@ def run_multichip_bench(
     }
 
 
+#: Cache-replay knobs (overridable by env).
+CACHE_REPLAY_NODES = int(_os.environ.get("FISHNET_CACHE_REPLAY_NODES", 280))
+
+
+def run_cache_replay_bench(nodes: int = CACHE_REPLAY_NODES) -> dict:
+    """Position-keyed eval reuse benchmark (ISSUE 11): one workload run
+    three times under the gated deterministic discipline —
+
+    * ``off``  — FISHNET_NO_EVAL_CACHE=1 (the parity baseline),
+    * ``cold`` — cache enabled but reset (populates it),
+    * ``warm`` — a NEW service (fresh pool + fresh pool-TT, the
+      supervisor-respawn shape) against the surviving process cache.
+
+    The headline is the warm-over-cold device dispatch reduction:
+    every position the warm run steps was evaluated by the cold run, so
+    its batches resolve pre-wire (whole-batch skips) instead of riding
+    the transport. ``parity`` pins the hard requirement — off, cold and
+    warm analyses bit-identical — and the exactly-once ledger audits
+    all three phases."""
+    from fishnet_tpu.resilience import accounting
+    from fishnet_tpu.search import eval_cache
+    from fishnet_tpu.search.service import SearchService
+
+    weights = material_weights()
+    jobs = make_workload(12, 6, seed=44)
+
+    class _Gated(SearchService):
+        def __init__(self, *a, **k):
+            self.gate = threading.Event()
+            super().__init__(*a, **k)
+
+        def warmup(self):
+            super().warmup()
+            self.gate.wait()
+
+    def run_once(tag, ledger):
+        svc = _Gated(
+            weights=weights, pool_slots=32, batch_capacity=256,
+            tt_bytes=16 << 20, pipeline_depth=4, driver_threads=1,
+        )
+        try:
+            # Pinned speculation: TT evolution (and so the schedule) is
+            # a deterministic function of the submission sequence.
+            svc.set_prefetch(0, adaptive=False)
+            before = svc.counters()
+            t0 = time.perf_counter()
+
+            async def go():
+                async def one(i, fen, moves):
+                    bid = f"cache-{tag}-{i}"
+                    ledger.record_acquired(bid)
+                    r = await svc.search(fen, moves, nodes=nodes)
+                    ledger.record_submitted(bid)
+                    return (
+                        r.best_move, r.depth, r.nodes,
+                        tuple(
+                            (l.multipv, l.depth, l.is_mate, l.value,
+                             tuple(l.pv))
+                            for l in r.lines
+                        ),
+                    )
+
+                tasks = [
+                    asyncio.ensure_future(one(i, *j))
+                    for i, j in enumerate(jobs)
+                ]
+                await asyncio.sleep(0.3)  # let every submission queue
+                svc.gate.set()
+                return await asyncio.gather(*tasks)
+
+            analyses = asyncio.run(go())
+            elapsed = time.perf_counter() - t0
+            after = svc.counters()
+            d = {k: after[k] - before.get(k, 0) for k in after}
+            return analyses, d, elapsed
+        finally:
+            svc.gate.set()
+            svc.close()
+
+    def phase(d, elapsed):
+        shipped = max(1, d.get("evals_shipped", 0))
+        return {
+            "dispatches": d.get("dispatches", 0),
+            "eval_steps": d.get("eval_steps", 0),
+            "nodes": d.get("nodes", 0),
+            "nodes_per_eval": round(d.get("nodes", 0) / shipped, 3),
+            # Stepped entries answered by the process cache BEFORE the
+            # wire (evals_shipped counts pool emissions, skipped or
+            # not, so the hit rate is a true pre-dispatch fraction).
+            "eval_cache_hit_rate": round(
+                d.get("cache_prewire_hits", 0) / shipped, 4
+            ),
+            "position_dedup_per_dispatch": round(
+                d.get("position_dedup", 0)
+                / max(1, d.get("dispatches", 0)),
+                3,
+            ),
+            "prewire_hits": d.get("cache_prewire_hits", 0),
+            "skipped_dispatches": d.get("cache_skipped_dispatches", 0),
+            "seconds": round(elapsed, 2),
+        }
+
+    ledger = accounting.install()
+    saved = _os.environ.get("FISHNET_NO_EVAL_CACHE")
+    try:
+        _os.environ["FISHNET_NO_EVAL_CACHE"] = "1"
+        try:
+            off_out, off_d, off_s = run_once("off", ledger)
+        finally:
+            if saved is None:
+                _os.environ.pop("FISHNET_NO_EVAL_CACHE", None)
+            else:
+                _os.environ["FISHNET_NO_EVAL_CACHE"] = saved
+        log(f"bench: cache-replay off  {phase(off_d, off_s)}")
+
+        eval_cache.reset_cache()  # guaranteed-cold first cache run
+        cold_out, cold_d, cold_s = run_once("cold", ledger)
+        log(f"bench: cache-replay cold {phase(cold_d, cold_s)}")
+        warm_out, warm_d, warm_s = run_once("warm", ledger)
+        log(f"bench: cache-replay warm {phase(warm_d, warm_s)}")
+        ledger_rep = ledger.report()
+    finally:
+        accounting.clear()
+
+    cache = eval_cache.get_cache()
+    cache_stats = cache.stats() if cache is not None else {}
+    reduction = 1.0 - warm_d.get("dispatches", 0) / max(
+        1, cold_d.get("dispatches", 0)
+    )
+    return {
+        "metric": "warm_dispatch_reduction",
+        "value": round(reduction, 4),
+        "unit": "fraction",
+        "mode": "cache_replay",
+        "nodes": nodes,
+        "positions": len(jobs),
+        "off": phase(off_d, off_s),
+        "cold": phase(cold_d, cold_s),
+        "warm": phase(warm_d, warm_s),
+        "parity": {
+            "off_vs_cold": off_out == cold_out,
+            "off_vs_warm": off_out == warm_out,
+            "positions": len(jobs),
+        },
+        "ledger": ledger_rep,
+        "cache": cache_stats,
+    }
+
+
 def bench_search_quality() -> dict:
     """Search QUALITY (depth at node budget) — a property of the search
     tree, not of the transport: the scalar backend walks the same tree
@@ -1440,7 +1618,25 @@ def main(argv=None) -> None:
         help="multichip-mode per-device-count window (default: "
         f"{MULTICHIP_SECONDS:.0f}s)",
     )
+    parser.add_argument(
+        "--cache-replay", action="store_true",
+        help="run the position-keyed eval reuse benchmark instead of "
+        "the throughput tiers: one workload run cache-off, cache-cold "
+        "and cache-warm (fresh service, surviving process cache), "
+        "reporting the warm-over-cold dispatch reduction, three-way "
+        "bit parity, and the exactly-once ledger (see "
+        "run_cache_replay_bench)",
+    )
     args = parser.parse_args(argv)
+
+    if args.cache_replay:
+        log(
+            f"bench: cache-replay mode — {CACHE_REPLAY_NODES} nodes per "
+            "search, off/cold/warm phases..."
+        )
+        summary = run_cache_replay_bench()
+        emit_summary(summary, args.json_out)
+        return
 
     if args.multichip:
         import jax as _jax

@@ -44,7 +44,11 @@ extern "C" {
 // 9: fc_pool_step's out_material may be nullptr — the material column
 //    is optional on the wire (device-resident PSQT path; kept for the
 //    CPU/XLA host-material fallback and tests).
-int fc_abi_version() { return 9; }
+// 10: position-keyed eval reuse exports — fc_pool_batch_hashes
+//     (Zobrist hashes of the pending batch), fc_pool_cancel_anchors
+//     (pre-provide anchor invalidation for skipped dispatches),
+//     fc_pool_tt_fill (provide-time TT fill from the host eval cache).
+int fc_abi_version() { return 10; }
 
 int fc_init() {
   init_bitboards();

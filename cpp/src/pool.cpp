@@ -149,6 +149,11 @@ struct Slot {
   int32_t anchor_psqt[2][NNUE_PSQT_BUCKETS];
   int32_t pending_psqt[2][NNUE_PSQT_BUCKETS];
   int32_t eval_values[EVAL_BLOCK_MAX];
+  // Zobrist hash of the position behind each block entry, in fill
+  // (wire) order — the key the host-side eval-reuse plane needs to
+  // short-circuit or dedup entries before dispatch (ABI 10;
+  // fc_pool_batch_hashes exports them batch-ordered).
+  uint64_t pos_hash[EVAL_BLOCK_MAX];
 };
 
 namespace {
@@ -385,6 +390,7 @@ void BatchedEval::evaluate_block(const Position* positions, int n, int32_t* out)
       slot_->material[k] =
           (slot_->psqt[k][0][slot_->buckets[k]] -
            slot_->psqt[k][1][slot_->buckets[k]]) / 2;
+      slot_->pos_hash[k] = pos.hash;
     }
     if (*anchors_) {
       // Block entry 0 becomes the slot's device anchor once this block
@@ -1144,6 +1150,51 @@ int fc_pool_result_line(SearchPool* pool, int slot_id, int line_idx,
     pos.make(m);
   }
   return copy_str(out, pv, pvlen);
+}
+
+// Export the Zobrist hashes of `group`'s current pending batch, batch
+// order (ABI 10). Owner-thread only (same discipline as step/provide).
+// Writes min(batch, cap) hashes into `out`, returns the batch size so
+// a too-small buffer is detectable.
+int fc_pool_batch_hashes(SearchPool* pool, int group, uint64_t* out, int cap) {
+  if (group < 0 || group >= pool->n_groups) group = 0;
+  auto& batch = pool->group_batch[group];
+  int n = int(batch.size()) < cap ? int(batch.size()) : cap;
+  for (int i = 0; i < n; i++) {
+    auto [sid, bidx] = batch[i];
+    out[i] = pool->slots[sid]->pos_hash[bidx];
+  }
+  return int(batch.size());
+}
+
+// Invalidate the device-resident anchors of every slot whose block sits
+// in `group`'s pending batch (ABI 10). Required before providing values
+// for a batch the caller decided NOT to ship to the device: emit_block
+// already committed entry 0 as the slot's anchor, but the device
+// anchor-table row was never (re)written, so later blocks must reseed
+// with a full entry instead of delta-ing against a stale row. Owner-
+// thread only. Returns the number of slots invalidated.
+int fc_pool_cancel_anchors(SearchPool* pool, int group) {
+  if (group < 0 || group >= pool->n_groups) group = 0;
+  int n = 0;
+  for (auto& [sid, bidx] : pool->group_batch[group]) {
+    if (bidx != 0) continue;
+    Slot& slot = *pool->slots[sid];
+    if (slot.anchor_valid) n++;
+    slot.anchor_valid = false;
+    slot.pending_anchor_valid = false;
+  }
+  return n;
+}
+
+// Provide-time TT fill (ABI 10): land an externally-known static eval
+// (e.g. the process-wide Python EvalCache) in the pool's own TT so the
+// next search touching `key` takes the tt_eval_hits fast path and never
+// requests the eval at all. The lockless xor-validated TT is safe to
+// call from any thread; store_eval never evicts entries carrying
+// bounds/evals for other keys.
+void fc_pool_tt_fill(SearchPool* pool, uint64_t key, int32_t eval) {
+  pool->tt.store_eval(key, int(eval));
 }
 
 void fc_pool_release(SearchPool* pool, int slot_id) {

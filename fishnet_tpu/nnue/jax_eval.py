@@ -395,13 +395,25 @@ def _packed_anchored_core(
     psqt_tab: jax.Array,
     use_pallas: Optional[bool],
     interpret: bool,
+    copy_src: Optional[jax.Array] = None,
 ):
     """Shared tail of the anchored packed entry points (single-group and
     segmented): expand the row stream, run the fused/XLA accumulate with
     table resolution, evaluate the head, and scatter anchor entries'
     resolved accumulators (and PSQT twins) back to their table rows.
     ``anchor_tab``/``psqt_tab`` are FLAT [A, 2, ...]; returns
-    ``(values, new_tab, new_psqt_tab)`` with the same flat shapes."""
+    ``(values, new_tab, new_psqt_tab)`` with the same flat shapes.
+
+    ``copy_src`` (optional int32 [B], the position-dedup fan-in from
+    ``plan_segment_dedup``) redirects entries to a same-position source:
+    after resolution, entry i's accumulator (and PSQT twin) is replaced
+    by ``acc[copy_src[i]]`` — identity for kept entries. This is what
+    makes PERSISTENT duplicates droppable from the wire: a sentinel'd
+    store entry resolves to garbage, but the gather swaps in its
+    source's accumulator (bit-identical — same position, same features)
+    BEFORE the head eval and the anchor-table scatter, so the store
+    still refreshes its row with the exact bytes the undropped entry
+    would have written."""
     from fishnet_tpu.ops.ft_gather import decode_parent, ft_accumulate
 
     dense = expand_packed(packed, offsets, parent)
@@ -430,6 +442,13 @@ def _packed_anchored_core(
             parent=parent,
             anchor_tab=anchor_tab,
         )
+    if copy_src is not None:
+        # Position-dedup fan-in: duplicates take their source's resolved
+        # accumulator (identity for non-duplicates), so sentinel'd store
+        # entries still scatter the true bytes to their table rows.
+        acc = jnp.take(acc, copy_src, axis=0)
+        if psqt is not None:
+            psqt = jnp.take(psqt, copy_src, axis=0)
     values = _evaluate_from_acc(
         params, acc, dense, buckets, parent, material, psqt=psqt
     )
@@ -469,6 +488,7 @@ def evaluate_packed_anchored_segmented(
     psqt_tabs: jax.Array,
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
+    copy_src: Optional[jax.Array] = None,
 ):
     """K groups' packed row streams fused into ONE device dispatch — the
     coalesced-dispatch wire (doc/wire-format.md "Segmented dispatch").
@@ -495,6 +515,11 @@ def evaluate_packed_anchored_segmented(
 
     Returns ``(values [K*size], new_anchor_tabs, new_psqt_tabs)``;
     segment k's real entries are ``values[k*size : k*size + n_k]``.
+
+    ``copy_src`` (optional int32 [K*size], flat global indices) is the
+    position-dedup fan-in — see ``_packed_anchored_core``. Segments of
+    one fused dispatch always share a device, so cross-segment sources
+    are plain local gathers.
     """
     from fishnet_tpu.ops.ft_gather import (
         derive_segment_offsets,
@@ -512,7 +537,7 @@ def evaluate_packed_anchored_segmented(
     flat_ptab = psqt_tabs.reshape(k_segs * anchor_rows, 2, -1)
     values, new_tab, new_ptab = _packed_anchored_core(
         params, packed, offsets, buckets, gparent, material,
-        flat_tab, flat_ptab, use_pallas, interpret,
+        flat_tab, flat_ptab, use_pallas, interpret, copy_src=copy_src,
     )
     return (
         values,

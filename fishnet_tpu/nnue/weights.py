@@ -69,6 +69,24 @@ class NnueWeights:
             out_bias=rng.integers(-8192, 8193, (b, 1)).astype(np.int32),
         )
 
+    def fingerprint(self) -> int:
+        """Stable 64-bit digest of the CANONICAL serialized form (what
+        ``save`` writes), so ``w.fingerprint()`` equals a blake2b over
+        the ``.nnue`` file byte-for-byte. The eval cache mixes this into
+        its keys so a process serving (or respawning into) a different
+        network never reads the old network's evals
+        (search/eval_cache.py net_fingerprint)."""
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=8)
+
+        class _HashSink:
+            def write(self, b: bytes) -> None:
+                h.update(b)
+
+        self._write(_HashSink())
+        return int.from_bytes(h.digest(), "little")
+
     # -- serialization ----------------------------------------------------
 
     def save(self, path: Union[str, Path]) -> None:

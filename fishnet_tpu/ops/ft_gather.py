@@ -184,7 +184,8 @@ def recode_segment_parents(parent: jax.Array, anchor_rows: int) -> jax.Array:
     return out.reshape(-1)
 
 
-def plan_segment_dedup(parents, buckets, offsets, ns, packed, material=None):
+def plan_segment_dedup(parents, buckets, offsets, ns, packed, material=None,
+                       hashes=None, cache_hits=None):
     """Plan cross-segment eval-dedup for ONE fused (coalesced) dispatch:
     deterministic, pure host-side planning (numpy in, plain lists out).
 
@@ -218,20 +219,56 @@ def plan_segment_dedup(parents, buckets, offsets, ns, packed, material=None):
     replacement computes garbage on device and its true value is
     restored host-side from its original (_FusedValues).
 
+    POSITION-KEYED MODE (doc/eval-cache.md): when ``hashes`` carries
+    per-segment uint64 Zobrist arrays, the dedup key is the position
+    hash itself instead of the 4-row byte image — bucket and material
+    are pure functions of the position, so the hash subsumes them, and
+    a duplicate now matches ANY earlier kept entry decoding to the same
+    position (delta-encoded entries included; a delta's device output
+    is its true eval, so it is a valid fan-out source). The droppable
+    set widens to EVERY encoding, because anchored traffic is ~100%
+    persistent codes (each block's entry 0 stores its anchor row) and a
+    plain-full-only rule would never fire:
+
+    * plain fulls and in-batch deltas re-encode as the one-row sentinel
+      in-batch delta exactly as before (nothing resolves through them —
+      unconsumed — and they write no table row);
+    * PERSISTENT codes (<= -2) re-encode as a one-row sentinel
+      persistent DELTA that KEEPS the original aid and store bit, so
+      the entry still refreshes its anchor-table row on device. The
+      bytes it stores are made correct by the eval's ``copy_src``
+      fan-in gather (_packed_anchored_core): the duplicate's resolved
+      accumulator is replaced by its same-position source's before the
+      head eval and the scatter. A persistent drop therefore REQUIRES
+      an in-dispatch source (a ``pairs`` entry) — cache-satisfied fills
+      have no device accumulator to store, so cache drops stay
+      restricted to plain fulls and in-batch deltas.
+    ``cache_hits`` (optional, per-segment ``(mask, values)`` from the
+    driver's pre-dispatch probe) additionally drops droppable entries
+    whose eval the process-wide cache already knows.
+
     Returns ``(drops, refs, pairs)``: per-segment lists of dropped
-    entry indices, the matching in-batch anchor refs for the
-    replacement codes (``ref << 1``, swap 0 — the most recent preceding
-    KEPT anchor, always present since entry 0 is an anchor and never
-    dropped), and global ``(dst_seg, dst_idx, src_seg, src_idx)`` value
-    overwrites (every duplicate maps to the FIRST occurrence, which is
-    by construction never itself dropped)."""
+    entry indices, the replacement-code metadata, and global
+    ``(dst_seg, dst_idx, src_seg, src_idx)`` value overwrites (every
+    duplicate maps to the FIRST occurrence, which is by construction
+    never itself dropped). ``refs`` in BYTE mode are in-batch anchor
+    indices (the caller writes ``ref << 1``, swap 0 — the most recent
+    preceding KEPT anchor, always present since entry 0 is an anchor
+    and never dropped); in POSITION-KEYED mode they are ready-to-write
+    WIRE PARENT CODES (sentinel in-batch delta or sentinel persistent
+    delta, per the drop's original encoding). In position-keyed mode a
+    FOURTH element is returned: ``fills`` — ``(seg, idx, value)``
+    cache-satisfied drops whose value comes from the cache, not from
+    another entry of this dispatch."""
     import numpy as np
 
     n_segs = len(parents)
     seen = {}
+    fill_vals = {}  # hash -> cached value (position-keyed mode)
     drops = [[] for _ in range(n_segs)]
     refs = [[] for _ in range(n_segs)]
     pairs = []
+    fills = []
     for k in range(n_segs):
         n = int(ns[k])
         if n <= 0:
@@ -247,10 +284,49 @@ def plan_segment_dedup(parents, buckets, offsets, ns, packed, material=None):
         is_full4 = (p == -1) | ((p <= -2) & ((((-p - 2) >> 1) & 1) == 0))
         off = np.asarray(offsets[k][:n])
         rows = packed[k]
+        hseg = None if hashes is None else hashes[k]
+        cmask = cvals = None
+        if cache_hits is not None and cache_hits[k] is not None:
+            cmask, cvals = cache_hits[k]
         last_anchor = 0
         for i in range(n):
             dropped = False
-            if is_full4[i]:
+            if hseg is not None:
+                h = int(hseg[i])
+                pers = bool(p[i] <= -2)
+                droppable = not consumed[i] and i > 0
+                # A persistent drop still stores its anchor row: its
+                # sentinel keeps aid + store bit (delta form, swap 0)
+                # and the copy_src gather supplies the true bytes.
+                sentinel = (
+                    -(2 + ((((-int(p[i]) - 2) >> 2) << 2) | 2))
+                    if pers else (last_anchor << 1)
+                )
+                src = seen.get(h)
+                if droppable and src is not None:
+                    # Fan out from the earlier kept entry (any wire
+                    # encoding — its device output is the true eval).
+                    drops[k].append(i)
+                    refs[k].append(sentinel)
+                    pairs.append((k, i, src[0], src[1]))
+                    dropped = True
+                elif droppable and not pers and cmask is not None \
+                        and cmask[i]:
+                    drops[k].append(i)
+                    refs[k].append(sentinel)
+                    fills.append((k, i, int(cvals[i])))
+                    fill_vals.setdefault(h, int(cvals[i]))
+                    dropped = True
+                elif droppable and not pers and h in fill_vals:
+                    # Duplicate of an entry that itself left the wire on
+                    # a cache hit: same cached value, no device source.
+                    drops[k].append(i)
+                    refs[k].append(sentinel)
+                    fills.append((k, i, fill_vals[h]))
+                    dropped = True
+                elif src is None:
+                    seen[h] = (k, i)
+            elif is_full4[i]:
                 key = (int(buckets[k][i]),
                        rows[off[i] : off[i] + 4].tobytes())
                 if material is not None:
@@ -266,6 +342,8 @@ def plan_segment_dedup(parents, buckets, offsets, ns, packed, material=None):
                     seen[key] = (k, i)
             if not dropped and is_anchor[i]:
                 last_anchor = i
+    if hashes is not None:
+        return drops, refs, pairs, fills
     return drops, refs, pairs
 
 

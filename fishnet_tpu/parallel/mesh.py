@@ -55,16 +55,28 @@ def serving_devices(requested=None) -> List[jax.Device]:
 
 
 class ShardRouter:
-    """Deterministic pipeline-group -> mesh-slot assignment for the
+    """Occupancy-weighted pipeline-group -> mesh-slot assignment for the
     placement-aware coalescer (doc/sharding.md).
 
-    Groups are assigned round-robin (group g -> shard g % n_shards), so
-    each driver thread's contiguous group range spreads over the mesh
-    and every shard sees traffic from the first step. The assignment is
-    pure function of (n_groups, n_shards) until a shard is ``drain``ed —
-    the per-shard degradation ladder's last resort — after which the
-    dead shard's groups move round-robin over the surviving shards,
-    again deterministically.
+    Groups start on the deterministic round-robin layout (group g ->
+    shard g % n_shards), so each driver thread's contiguous group range
+    spreads over the mesh and table placement is decidable before any
+    traffic. The load-balancing step happens at a group's FIRST traffic
+    (``note_occupancy``, called by the coalescer per submitted
+    microbatch): the group is re-homed to the least-loaded alive shard —
+    ordered by (occupancy EMA, assigned-group count, keep-current,
+    shard id) — which is a no-op while the mesh is balanced (ties
+    prefer the current home) but moves a waking group off a hot shard
+    onto an idle one, the MULTICHIP_r06 failure mode (8-shard
+    dispatches [253,240,0,0,8,34,35,20] under pure round-robin).
+    ``FISHNET_SHARD_PLACEMENT=rr`` restores the static assignment.
+
+    With no traffic the assignment stays a pure function of (n_groups,
+    n_shards), including after ``drain`` — the per-shard degradation
+    ladder's last resort — which re-homes the dead shard's groups
+    least-loaded-first (identical to the old round-robin walk when
+    loads are equal, which keeps the drain decision deterministic in
+    the fault drills).
 
     Thread safety: every driver thread reads ``shard_of`` per step while
     a degrading sibling may be draining — all state is guarded by one
@@ -80,10 +92,46 @@ class ShardRouter:
         self._lock = threading.Lock()
         self._alive = list(range(n_shards))
         self._assign = {g: g % n_shards for g in range(n_groups)}
+        self._rr_only = (
+            os.environ.get("FISHNET_SHARD_PLACEMENT", "lb") == "rr"
+        )
+        self._active: set = set()
+        self._load = [0.0] * n_shards
+
+    def _least_loaded_locked(self, current: Optional[int] = None) -> int:
+        counts = {s: 0 for s in self._alive}
+        for s in self._assign.values():
+            if s in counts:
+                counts[s] += 1
+        return min(
+            self._alive,
+            key=lambda s: (
+                self._load[s], counts[s], 0 if s == current else 1, s
+            ),
+        )
 
     def shard_of(self, group: int) -> int:
         with self._lock:
             return self._assign[group]
+
+    def note_occupancy(self, group: int, n: int) -> None:
+        """Record one submitted microbatch of ``n`` entries against
+        ``group``'s shard (EMA matching the coalescer's width policy).
+        A group's first note re-homes it to the least-loaded shard
+        unless FISHNET_SHARD_PLACEMENT=rr pins the static layout."""
+        with self._lock:
+            s = self._assign[group]
+            if not self._rr_only and group not in self._active:
+                self._active.add(group)
+                tgt = self._least_loaded_locked(current=s)
+                if tgt != s:
+                    self._assign[group] = tgt
+                    s = tgt
+            self._load[s] = 0.8 * self._load[s] + 0.2 * float(n)
+
+    def shard_loads(self) -> List[float]:
+        with self._lock:
+            return list(self._load)
 
     def groups_of(self, shard: int) -> List[int]:
         with self._lock:
@@ -98,10 +146,12 @@ class ShardRouter:
             return list(self._alive)
 
     def drain(self, shard: int) -> Dict[int, int]:
-        """Mark ``shard`` dead and reassign its groups round-robin over
-        the surviving shards. Returns {group: new_shard} for the moved
-        groups. Raises RuntimeError when no shard would remain — the
-        caller escalates to the whole-service failure path."""
+        """Mark ``shard`` dead and reassign its groups over the
+        surviving shards — least-loaded-first (round-robin under
+        FISHNET_SHARD_PLACEMENT=rr, and equivalent to it when loads are
+        level). Returns {group: new_shard} for the moved groups. Raises
+        RuntimeError when no shard would remain — the caller escalates
+        to the whole-service failure path."""
         with self._lock:
             if shard in self._alive:
                 if len(self._alive) == 1:
@@ -110,7 +160,10 @@ class ShardRouter:
             moved = {}
             drained = sorted(g for g, s in self._assign.items() if s == shard)
             for i, g in enumerate(drained):
-                tgt = self._alive[i % len(self._alive)]
+                if self._rr_only:
+                    tgt = self._alive[i % len(self._alive)]
+                else:
+                    tgt = self._least_loaded_locked()
                 self._assign[g] = tgt
                 moved[g] = tgt
             return moved

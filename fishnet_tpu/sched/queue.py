@@ -842,6 +842,18 @@ class QueueStub:
         if pending is None:
             return
         completed = pending.try_into_completed()
+        if completed is not None:
+            # Batch completion ticks the eval cache's eviction clock
+            # (search/eval_cache.py): entries inserted while this batch
+            # was live age one generation, so under memory pressure the
+            # cache sheds dead batches' positions before the live
+            # working set. Purely an eviction-ordering signal — values
+            # are never invalidated by it.
+            from fishnet_tpu.search import eval_cache
+
+            cache = eval_cache.get_cache()
+            if cache is not None:
+                cache.advance_generation()
         if completed is None:
             if not pending.work.matrix_wanted:
                 report = pending.progress_report()

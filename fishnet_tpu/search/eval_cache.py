@@ -1,0 +1,324 @@
+"""Process-wide position-keyed eval reuse plane.
+
+One ``EvalCache`` per process maps Zobrist position hash -> (static
+eval, generation). It is shared across pipeline groups, mesh shards,
+tenants and — because it outlives any single ``SearchService`` — across
+pool respawns, which is exactly where the pool's own TT (torn down with
+the pool) loses its history. The service probes it in the driver loop
+right after ``fc_pool_step`` hands over a batch (whole-batch
+short-circuit: every entry cached -> the dispatch is skipped entirely)
+and inside ``plan_segment_dedup`` (per-entry drops inside a fused
+dispatch), and inserts at provide time — the one site every ladder rung
+(fused / xla / host-material), the coalescer-off path and the mesh path
+all funnel through.
+
+Correctness stance: the NNUE static eval is a pure function of the
+position, so substituting a cached value for a recomputed one is
+bit-identical (modulo 64-bit Zobrist collisions — the same accepted
+risk the native TT already carries). ``FISHNET_NO_EVAL_CACHE=1``
+disables every probe/insert; cold-cache and cache-off runs must produce
+byte-identical analyses (gated by ``make cache-smoke``).
+
+Concurrency: lock-striped buckets (doc/static-analysis.md R4 — every
+stripe access holds that stripe's lock). Writers are the per-group
+driver threads at provide time; each batch's inserts scatter over
+stripes, so cross-group contention is bounded by stripe count, not by a
+global lock. Memory is bounded: each stripe holds at most
+``capacity // stripes`` entries, and overflow evicts the oldest
+*generations* first (a generation advances at batch completion, see
+``sched/queue.py``), so entries from long-dead batches leave before the
+working set of live ones.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: Default bound on total entries (score + generation per entry; at the
+#: default 1M entries the table tops out around ~100 MB of dict
+#: overhead — a deliberate host-RAM-for-dispatches trade).
+DEFAULT_CAPACITY = 1 << 20
+
+#: Stripe count: enough that 8 driver threads rarely collide, small
+#: enough that the per-stripe capacity stays meaningful at tiny test
+#: capacities.
+DEFAULT_STRIPES = 64
+
+
+def cache_disabled() -> bool:
+    """The escape hatch, read per call so tests can monkeypatch env."""
+    return os.environ.get("FISHNET_NO_EVAL_CACHE", "") == "1"
+
+
+def net_fingerprint(path: str) -> int:
+    """64-bit blake2b of the ``.nnue`` file — the network-identity salt
+    the service XORs into every cache key. Positions only collide with
+    themselves *under the same network*: a respawn onto updated weights
+    (or a second service with a different net in the same process)
+    keys a disjoint region of the shared cache instead of reading the
+    old network's evals. Matches ``NnueWeights.fingerprint()`` because
+    ``save`` writes the canonical form this hashes."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=8)
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return int.from_bytes(h.digest(), "little")
+
+
+class EvalCache:
+    """Sharded hash -> (eval, generation) map with striped locking and
+    generation-based eviction. All methods are thread-safe."""
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        stripes: int = DEFAULT_STRIPES,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        stripes = max(1, min(int(stripes), int(capacity)))
+        # Per-stripe cap; rounding up keeps tiny-capacity configs usable.
+        self._stripe_cap = max(1, (int(capacity) + stripes - 1) // stripes)
+        self._locks = [threading.Lock() for _ in range(stripes)]
+        self._stripes: List[Dict[int, Tuple[int, int]]] = [
+            {} for _ in range(stripes)
+        ]
+        self._n_stripes = stripes
+        # Generation clock + stats share one leaf lock (cold counters;
+        # the per-probe hit/miss tallies are batched by callers).
+        self._meta_lock = threading.Lock()
+        self._generation = 0
+        self._hits = 0
+        self._misses = 0
+        self._insertions = 0
+        self._evictions = 0
+
+    # -- internals --------------------------------------------------------
+
+    def _stripe_of(self, h: int) -> int:
+        # Mix the high bits in: Zobrist hashes are uniform, but the TT
+        # downstream indexes on low bits — keep the stripe choice
+        # decorrelated from any other consumer of the same hash.
+        return ((h >> 48) ^ h) % self._n_stripes
+
+    def _evict_locked(self, s: int) -> None:
+        """Drop the oldest generation(s) from stripe `s` until it is
+        under its cap. Caller holds the stripe lock."""
+        stripe = self._stripes[s]
+        dropped = 0
+        while len(stripe) >= self._stripe_cap and stripe:
+            oldest = min(g for (_, g) in stripe.values())
+            stale = [h for h, (_, g) in stripe.items() if g == oldest]
+            for h in stale:
+                del stripe[h]
+            dropped += len(stale)
+        if dropped:
+            with self._meta_lock:
+                self._evictions += dropped
+
+    # -- core API ---------------------------------------------------------
+
+    def probe(self, h: int) -> Optional[int]:
+        """Cached eval for hash `h`, or None. A hit refreshes the
+        entry's generation (hot openings outlive eviction sweeps)."""
+        s = self._stripe_of(h)
+        gen = self._generation
+        with self._locks[s]:
+            ent = self._stripes[s].get(h)
+            if ent is not None:
+                self._stripes[s][h] = (ent[0], gen)
+        with self._meta_lock:
+            if ent is None:
+                self._misses += 1
+            else:
+                self._hits += 1
+        return None if ent is None else ent[0]
+
+    def insert(self, h: int, value: int) -> None:
+        s = self._stripe_of(h)
+        gen = self._generation
+        with self._locks[s]:
+            stripe = self._stripes[s]
+            if h not in stripe and len(stripe) >= self._stripe_cap:
+                self._evict_locked(s)
+            stripe[h] = (int(value), gen)
+        with self._meta_lock:
+            self._insertions += 1
+
+    def probe_block(
+        self, hashes: np.ndarray, out: Optional[np.ndarray] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Vector probe for one batch: returns ``(values, hit_mask)``
+        with ``values[i]`` valid where ``hit_mask[i]``. Misses are NOT
+        charged per-entry locks twice: each hash takes exactly one
+        stripe-lock round trip."""
+        n = len(hashes)
+        values = out if out is not None else np.zeros(n, dtype=np.int32)
+        mask = np.zeros(n, dtype=bool)
+        hits = 0
+        gen = self._generation
+        for i in range(n):
+            h = int(hashes[i])
+            s = self._stripe_of(h)
+            with self._locks[s]:
+                ent = self._stripes[s].get(h)
+                if ent is not None:
+                    self._stripes[s][h] = (ent[0], gen)
+            if ent is not None:
+                values[i] = ent[0]
+                mask[i] = True
+                hits += 1
+        with self._meta_lock:
+            self._hits += hits
+            self._misses += n - hits
+        return values, mask
+
+    def insert_block(self, hashes: np.ndarray, values: np.ndarray) -> None:
+        """Single-writer batch insert (the provide-time fill path)."""
+        n = min(len(hashes), len(values))
+        gen = self._generation
+        for i in range(n):
+            h = int(hashes[i])
+            s = self._stripe_of(h)
+            with self._locks[s]:
+                stripe = self._stripes[s]
+                if h not in stripe and len(stripe) >= self._stripe_cap:
+                    self._evict_locked(s)
+                stripe[h] = (int(values[i]), gen)
+        with self._meta_lock:
+            self._insertions += n
+
+    # -- generations ------------------------------------------------------
+
+    def advance_generation(self) -> int:
+        """Tick the eviction clock (called at batch completion by the
+        scheduler, ``sched/queue.py``). Entries keep their insert/touch
+        generation; eviction drops oldest-generation entries first."""
+        with self._meta_lock:
+            self._generation += 1
+            return self._generation
+
+    # -- introspection ----------------------------------------------------
+
+    def __len__(self) -> int:
+        total = 0
+        for s in range(self._n_stripes):
+            with self._locks[s]:
+                total += len(self._stripes[s])
+        return total
+
+    def stats(self) -> Dict[str, int]:
+        with self._meta_lock:
+            st = {
+                "hits": self._hits,
+                "misses": self._misses,
+                "insertions": self._insertions,
+                "evictions": self._evictions,
+                "generation": self._generation,
+            }
+        st["entries"] = len(self)
+        return st
+
+    def clear(self) -> None:
+        """Drop all entries (stats and generation survive) — the bench's
+        cold-run reset."""
+        for s in range(self._n_stripes):
+            with self._locks[s]:
+                self._stripes[s].clear()
+
+
+# -- process-wide singleton -----------------------------------------------
+
+_global_lock = threading.Lock()
+_global_cache: Optional[EvalCache] = None
+_collector_token: Optional[int] = None
+
+
+def _collect_families():
+    """Registry collector: entry count + eviction total for the process
+    cache (hit counters are exported by the service collector, where
+    the prewire/pool scope split lives)."""
+    cache = _global_cache
+    if cache is None:
+        return None  # self-unregister after reset_cache()
+    from ..telemetry.registry import counter_family, gauge_family
+
+    st = cache.stats()
+    return [
+        gauge_family(
+            "fishnet_eval_cache_entries",
+            "Live entries in the process-wide eval cache.",
+            st["entries"],
+        ),
+        counter_family(
+            "fishnet_eval_cache_evictions_total",
+            "Entries evicted from the eval cache (generation sweeps).",
+            st["evictions"],
+        ),
+    ]
+
+
+def get_cache() -> Optional[EvalCache]:
+    """The process-wide cache, or None when FISHNET_NO_EVAL_CACHE=1.
+    Created on first use; capacity via FISHNET_EVAL_CACHE_CAPACITY."""
+    if cache_disabled():
+        return None
+    global _global_cache, _collector_token
+    with _global_lock:
+        if _global_cache is None:
+            cap = int(
+                os.environ.get("FISHNET_EVAL_CACHE_CAPACITY", DEFAULT_CAPACITY)
+            )
+            _global_cache = EvalCache(capacity=cap)
+            from ..telemetry.registry import REGISTRY
+
+            _collector_token = REGISTRY.register_collector(
+                _collect_families, name="eval-cache"
+            )
+        return _global_cache
+
+
+def reset_cache() -> None:
+    """Tear down the process cache (tests / bench cold starts). The
+    registered collector self-unregisters on its next scrape."""
+    global _global_cache
+    with _global_lock:
+        _global_cache = None
+
+
+class MissHistory:
+    """Per-group cache-miss history window, feeding the prefetch-budget
+    steering policy (``SearchService._steer_prefetch``). Driver threads
+    record; any thread may read a rate — one leaf lock, cold path."""
+
+    def __init__(self, window: int = 2048) -> None:
+        self._lock = threading.Lock()
+        self._window = max(1, int(window))
+        self._probes: Dict[int, int] = {}
+        self._hits: Dict[int, int] = {}
+
+    def record(self, group: int, hits: int, probes: int) -> None:
+        with self._lock:
+            p = self._probes.get(group, 0) + probes
+            h = self._hits.get(group, 0) + hits
+            if p > self._window:
+                # Exponential forget: halve the window when it fills so
+                # the rate tracks the current traffic mix, not history.
+                p //= 2
+                h //= 2
+            self._probes[group] = p
+            self._hits[group] = h
+
+    def hit_rate(self, group: int) -> Optional[float]:
+        """Hit rate over the window, or None below a minimum sample."""
+        with self._lock:
+            p = self._probes.get(group, 0)
+            if p < 64:
+                return None
+            return self._hits.get(group, 0) / p

@@ -132,6 +132,18 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
     ]
     lib.fc_pool_set_anchors.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    # ABI 10: position-keyed eval reuse surface (doc/eval-cache.md).
+    lib.fc_pool_batch_hashes.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int,
+    ]
+    lib.fc_pool_batch_hashes.restype = ctypes.c_int
+    lib.fc_pool_cancel_anchors.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.fc_pool_cancel_anchors.restype = ctypes.c_int
+    lib.fc_pool_tt_fill.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32,
+    ]
+    lib.fc_pool_tt_fill.restype = None
     lib._pool_bound = True
 
 
@@ -341,6 +353,14 @@ _COUNTER_METRICS = {
                     "Eval entries deduplicated across segments of fused "
                     "dispatches (duplicate plain fulls shipped as one-row "
                     "sentinel deltas; values restored host-side)."),
+    "position_dedup": ("fishnet_position_dedup_total", "counter",
+                       "Eval entries dropped because another entry in the "
+                       "same fused dispatch carries the identical position "
+                       "(hash-keyed; value fanned out host-side)."),
+    "cache_skipped_dispatches": (
+        "fishnet_eval_cache_skipped_dispatches_total", "counter",
+        "Device dispatches skipped entirely because every entry of the "
+        "batch was satisfied by the process-wide eval cache."),
     "inflight_dispatches": ("fishnet_inflight_dispatches", "gauge",
                             "Device dispatches currently in flight in the "
                             "async pipeline (0..2: the ping-pong double "
@@ -378,6 +398,28 @@ def _register_service_collector(svc: "SearchService") -> int:
                 else _telemetry.counter_family
             )
             fams.append(maker(name, help_, value))
+        # Eval-cache hit split (doc/eval-cache.md): `prewire` hits were
+        # satisfied host-side from the process cache before any wire
+        # bytes moved; `pool` hits are the native TT's leaf-eval hits —
+        # after a provide-time fc_pool_tt_fill they include positions
+        # the cache taught the pool, so the two scopes together are the
+        # reuse plane's full effect.
+        fams.append(_telemetry.counter_family(
+            "fishnet_eval_cache_hits_total",
+            "Leaf evals satisfied by the position-keyed reuse plane, "
+            "by scope (prewire=host cache before dispatch, pool=native "
+            "TT inside the search).",
+            counters.get("cache_prewire_hits", 0),
+            labels={"scope": "prewire"},
+        ))
+        fams.append(_telemetry.counter_family(
+            "fishnet_eval_cache_hits_total",
+            "Leaf evals satisfied by the position-keyed reuse plane, "
+            "by scope (prewire=host cache before dispatch, pool=native "
+            "TT inside the search).",
+            counters.get("tt_eval_hits", 0),
+            labels={"scope": "pool"},
+        ))
         # The dispatches counter's canonical pairing (doc/observability
         # .md): fishnet_eval_steps_total is the per-group-microbatch
         # series fishnet_dispatches_total divides against (alias of the
@@ -506,24 +548,30 @@ class _FusedValues:
     so every consumer — owner slice or eager decode worker — sees the
     restored array."""
 
-    __slots__ = ("_arr", "_np", "_lock", "_dups")
+    __slots__ = ("_arr", "_np", "_lock", "_dups", "_fills")
 
-    def __init__(self, arr, dups=None) -> None:
+    def __init__(self, arr, dups=None, fills=None) -> None:
         self._arr = arr
         self._np = None
         self._dups = dups  # [(dst_flat, src_flat)] value overwrites
+        # [(dst_flat, value)] eval-cache hits: entries that rode the
+        # wire as sentinel deltas (device result is garbage) because the
+        # process cache already knew their value (doc/eval-cache.md).
+        self._fills = fills
         self._lock = threading.Lock()
 
     def materialize(self) -> np.ndarray:
         with self._lock:
             if self._np is None:
                 arr = np.asarray(self._arr)
-                if self._dups:
+                if self._dups or self._fills:
                     # np.asarray can hand back a read-only view of
                     # device memory — copy before patching.
                     arr = np.array(arr, copy=True)
-                    for dst, src in self._dups:
+                    for dst, src in self._dups or ():
                         arr[dst] = arr[src]
+                    for dst, val in self._fills or ():
+                        arr[dst] = val
                 self._np = arr
                 self._arr = None
             return self._np
@@ -544,11 +592,12 @@ class _CoalesceTicket:
 
     __slots__ = (
         "group", "n", "rows", "values", "start", "seg_size", "acct",
-        "error", "done", "trace",
+        "error", "done", "trace", "hashes", "cache_mask", "cache_vals",
     )
 
     def __init__(
-        self, group: int, n: int, rows: int, trace=None
+        self, group: int, n: int, rows: int, trace=None, hashes=None,
+        cache_mask=None, cache_vals=None,
     ) -> None:
         self.group = group
         self.n = n
@@ -560,6 +609,14 @@ class _CoalesceTicket:
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
         self.trace = trace
+        # Zobrist hashes of this microbatch's entries (batch order), or
+        # None when the eval cache is off: the position-dedup and
+        # cache-fill keys for the fused planner (doc/eval-cache.md).
+        # cache_mask/cache_vals carry the driver's pre-dispatch probe
+        # result so the planner never probes twice.
+        self.hashes = hashes
+        self.cache_mask = cache_mask
+        self.cache_vals = cache_vals
 
 
 class _DispatchCoalescer:
@@ -686,7 +743,8 @@ class _DispatchCoalescer:
             )
 
     def submit(
-        self, group: int, n: int, rows: int, trace=None
+        self, group: int, n: int, rows: int, trace=None, hashes=None,
+        cache_mask=None, cache_vals=None,
     ) -> _CoalesceTicket:
         """Park a stepped group's microbatch on its SHARD's pending
         list; returns its ticket. May flush (dispatch) on this thread if
@@ -694,7 +752,17 @@ class _DispatchCoalescer:
         device_step context) must ride the ticket from birth — the
         width trigger can flush inline before the caller ever sees the
         ticket."""
-        ticket = _CoalesceTicket(group, n, rows, trace=trace)
+        ticket = _CoalesceTicket(
+            group, n, rows, trace=trace, hashes=hashes,
+            cache_mask=cache_mask, cache_vals=cache_vals,
+        )
+        router = self._svc._router
+        if router is not None:
+            # Occupancy-weighted placement signal (doc/sharding.md): a
+            # group's first note may re-home it, so the note must land
+            # BEFORE shard_of resolves where this ticket parks. The
+            # router's lock is a leaf, safe outside self._lock.
+            router.note_occupancy(group, n)
         s = self._shard_of(group)
         flush = None
         with self._lock:
@@ -1575,6 +1643,40 @@ class SearchService:
         self._offset_buf = np.empty((k, cap), dtype=np.int32)
         self._bucket_buf = np.empty((k, cap), dtype=np.int32)
         self._slot_buf = np.empty((k, cap), dtype=np.int32)
+        # POSITION-KEYED EVAL REUSE (doc/eval-cache.md): the process-
+        # wide cache handle (None with FISHNET_NO_EVAL_CACHE=1 — every
+        # probe/insert site gates on it), per-group Zobrist-hash export
+        # buffers (fc_pool_batch_hashes, ABI 10) and cache-probe value
+        # scratch. Only meaningful on the builtin packed wire — the
+        # scalar backend and external evaluators never step a batch.
+        from fishnet_tpu.search import eval_cache as _eval_cache_mod
+
+        self._eval_cache = (
+            _eval_cache_mod.get_cache() if self._packed_wire else None
+        )
+        # Network-identity salt: XORed into every cache key so two
+        # services (or respawns) with different weights never read each
+        # other's evals out of the shared process cache. Zobrist hashes
+        # stay raw everywhere else (pool TT fills, segment dedup).
+        self._cache_salt = (
+            np.uint64(_eval_cache_mod.net_fingerprint(self.net_path))
+            if self._eval_cache is not None
+            else np.uint64(0)
+        )
+        self._hash_buf = np.empty((k, cap), dtype=np.uint64)
+        self._cache_val_buf = np.empty((k, cap), dtype=np.int32)
+        self._miss_hist = _eval_cache_mod.MissHistory()
+        # Opt-in cache-miss prefetch steering (tentpole part 4): high
+        # sustained hit rates pin the speculative budget down (the
+        # cache already serves those leaves for free), miss-heavy
+        # traffic restores the AIMD policy. Default off — steering
+        # changes dispatch composition, and the default configuration
+        # keeps the cold-cache path byte-identical to cache-off.
+        self._cache_steer = (
+            os.environ.get("FISHNET_CACHE_PREFETCH", "0") == "1"
+            and self._eval_cache is not None
+        )
+        self._steer_state: Dict[int, bool] = {}
         # Incremental-eval references (batch-relative parent codes; -1 =
         # full entry) emitted by the pool alongside the features.
         self._parent_buf = np.empty((k, cap), dtype=np.int32)
@@ -1601,6 +1703,11 @@ class SearchService:
         # step that ships the 1k bucket is not "5% occupied".
         self._eval_steps = [0] * T
         self._bucket_slots = [0] * T
+        # Eval-cache traffic counters (under self._lock: bumped per
+        # BATCH by driver/pack threads, read by counters()).
+        self._cache_prewire_hits = 0
+        self._cache_skipped_dispatches = 0
+        self._position_dedup = 0
         # Host->device payload actually shipped, split feature-side
         # (packed rows + buckets + parents + row count) vs the material
         # term — the split is what shows the ABI 9 wire saving in BENCH.
@@ -1950,6 +2057,39 @@ class SearchService:
             self._pool, int(budget), 1 if adaptive else 0
         )
 
+    #: Prefetch-steering hysteresis (FISHNET_CACHE_PREFETCH=1): pin the
+    #: speculation budget to 0 when the cache hit rate crosses _PIN
+    #: (speculative evals would mostly duplicate cached positions), and
+    #: restore the AIMD policy when it falls under _UNPIN.
+    _STEER_PIN = 0.6
+    _STEER_UNPIN = 0.3
+
+    def _steer_prefetch(self, group: int) -> None:
+        """Cache-miss-history prefetch steering (doc/eval-cache.md,
+        opt-in via FISHNET_CACHE_PREFETCH=1): consult ``group``'s
+        rolling cache hit rate and pin/unpin the pool's speculation
+        budget with hysteresis. The budget is pool-wide, so the steer
+        state is too — whichever driver thread crosses a threshold
+        first applies the transition."""
+        rate = self._miss_hist.hit_rate(group)
+        if rate is None:
+            return
+        with self._lock:
+            pinned = self._steer_state.get(0, False)
+            if not pinned and rate > self._STEER_PIN:
+                self._steer_state[0] = True
+            elif pinned and rate < self._STEER_UNPIN:
+                self._steer_state[0] = False
+            else:
+                return
+            pin = self._steer_state[0]
+        if pin:
+            self.set_prefetch(0, adaptive=False)
+        else:
+            # Re-seed the AIMD policy at one block's worth (the pool's
+            # own startup default, cpp EVAL_BLOCK_MAX).
+            self.set_prefetch(MIN_BATCH_CAPACITY, adaptive=True)
+
     def counters(self) -> Dict[str, int]:
         """Cumulative eval-traffic counters from the native pool —
         the measurements behind occupancy / prefetch-ROI / cache-rate
@@ -1990,6 +2130,19 @@ class SearchService:
             out["fused_dispatches"] = 0
             out["coalesced_steps"] = 0
             out["fused_dedup"] = 0
+        # Position-keyed eval reuse (doc/eval-cache.md): host-cache
+        # entries satisfied before any wire bytes moved (whole-batch
+        # skips + fused-plan fills), dispatches skipped outright, and
+        # hash-keyed cross-segment dedup drops.
+        with self._lock:
+            out["cache_prewire_hits"] = self._cache_prewire_hits
+            out["cache_skipped_dispatches"] = self._cache_skipped_dispatches
+            out["position_dedup"] = self._position_dedup
+        ec = self._eval_cache
+        if ec is not None:
+            st = ec.stats()
+            out["cache_entries"] = st["entries"]
+            out["cache_evictions"] = st["evictions"]
         # Async-pipeline instruments (0 when synchronous): in-flight
         # dispatch count, queue depth in front of the workers, and the
         # busy/dual integrals behind the overlap-ratio gauge (exported
@@ -2395,11 +2548,19 @@ class SearchService:
         # padding writes below (the planner reads only real entries).
         drops = refs = None
         dups_flat = None
+        fills = None
+        fills_flat = None
         eff_rows = [tk.rows for tk in tickets]
         if self._dedup_fused and len(tickets) > 1:
             from fishnet_tpu.ops.ft_gather import plan_segment_dedup
 
-            drops, refs, pairs = plan_segment_dedup(
+            # POSITION-KEYED MODE (doc/eval-cache.md): with the eval
+            # cache on, every ticket carries its batch's Zobrist hashes
+            # and the driver's pre-dispatch probe result — the planner
+            # dedups on position identity (delta-encoded sources
+            # included) and drops cache-known entries outright.
+            use_hash = all(tk.hashes is not None for tk in tickets)
+            planned = plan_segment_dedup(
                 [self._parent_buf[tk.group] for tk in tickets],
                 [self._bucket_buf[tk.group] for tk in tickets],
                 [self._offset_buf[tk.group] for tk in tickets],
@@ -2407,18 +2568,53 @@ class SearchService:
                 [self._packed_buf[tk.group] for tk in tickets],
                 None if not ship_material else
                 [self._material_buf[tk.group] for tk in tickets],
+                hashes=(
+                    [tk.hashes for tk in tickets] if use_hash else None
+                ),
+                cache_hits=(
+                    [
+                        None if tk.cache_mask is None
+                        else (tk.cache_mask, tk.cache_vals)
+                        for tk in tickets
+                    ] if use_hash else None
+                ),
             )
-            if pairs:
+            if use_hash:
+                drops, refs, pairs, fills = planned
+            else:
+                drops, refs, pairs = planned
+            if pairs or fills:
                 for k, tk in enumerate(tickets):
-                    # Every dropped full shrinks its stream 4 -> 1 row.
-                    eff_rows[k] = tk.rows - 3 * len(drops[k])
+                    # A dropped 4-row entry (plain full or persistent
+                    # FULL store) shrinks its stream 4 -> 1 row; dropped
+                    # deltas (in-batch or persistent) were 1 row already
+                    # — their win is the retired gather work, not wire
+                    # bytes.
+                    pcol = self._parent_buf[tk.group]
+                    full_drops = sum(
+                        1 for i in drops[k]
+                        if pcol[i] == -1 or (
+                            pcol[i] <= -2
+                            and (((-int(pcol[i]) - 2) >> 1) & 1) == 0
+                        )
+                    )
+                    eff_rows[k] = tk.rows - 3 * full_drops
                 dups_flat = [
                     (dk * size + di, sk * size + si)
                     for dk, di, sk, si in pairs
                 ]
+                if fills:
+                    fills_flat = [
+                        (fk * size + fi, val) for fk, fi, val in fills
+                    ]
                 co = self._coalescer
                 with co._lock:
                     co.deduped_evals += len(pairs)
+                with self._lock:
+                    if use_hash:
+                        self._position_dedup += len(pairs)
+                    if fills:
+                        self._cache_prewire_hits += len(fills)
         need = max(eff_rows) + 4
         tier = self._row_tiers(size)[-1]
         for rt in self._row_tiers(size):
@@ -2440,17 +2636,25 @@ class SearchService:
                 material_cat[k] = self._material_buf[g][:size]
         seg_parents = [self._parent_buf[tk.group][:size] for tk in tickets]
         seg_packed = [self._packed_buf[tk.group][:tier] for tk in tickets]
-        if dups_flat:
+        if dups_flat or fills_flat:
             for k, tk in enumerate(tickets):
                 if not drops[k]:
                     continue
                 g, n = tk.group, tk.n
                 drop_idx = np.asarray(drops[k], dtype=np.int64)
-                # Rewritten parent column: duplicates become in-batch
-                # deltas referencing their most recent preceding kept
-                # anchor (swap 0).
+                # Rewritten parent column. Byte mode: duplicates become
+                # in-batch deltas referencing their most recent
+                # preceding kept anchor (refs are anchor indices, swap
+                # 0). Hash mode: refs arrive as ready wire codes —
+                # sentinel in-batch deltas, or sentinel persistent
+                # deltas that keep their aid + store bit so the entry
+                # still refreshes its anchor-table row (the copy_src
+                # gather below supplies the true bytes).
                 p_new = seg_parents[k].copy()
-                p_new[drop_idx] = np.asarray(refs[k], np.int32) << 1
+                if use_hash:
+                    p_new[drop_idx] = np.asarray(refs[k], np.int32)
+                else:
+                    p_new[drop_idx] = np.asarray(refs[k], np.int32) << 1
                 seg_parents[k] = p_new
                 # Compact the row stream: kept entries keep their row
                 # spans, dropped ones collapse to one sentinel delta
@@ -2492,17 +2696,31 @@ class SearchService:
             self._place_group_tables(tk.group, dev)
         stacked = jnp.stack([self._anchor_tabs[tk.group] for tk in tickets])
         pstacked = jnp.stack([self._psqt_tabs[tk.group] for tk in tickets])
-        values, new_tabs, new_ptabs = seg_fn(
-            params, packed_cat, buckets_cat, parents_cat,
-            None if material_cat is None else material_cat.reshape(-1),
-            stacked, seg_rows, pstacked,
-        )
+        if dups_flat:
+            # Position-dedup fan-in (identity for kept entries): each
+            # duplicate takes its source's resolved accumulator on
+            # device, which is what lets sentinel'd PERSISTENT drops
+            # still scatter the exact bytes to their anchor-table rows.
+            copy_src = np.arange(len(tickets) * size, dtype=np.int32)
+            for d, s in dups_flat:
+                copy_src[d] = s
+            values, new_tabs, new_ptabs = seg_fn(
+                params, packed_cat, buckets_cat, parents_cat,
+                None if material_cat is None else material_cat.reshape(-1),
+                stacked, seg_rows, pstacked, copy_src=copy_src,
+            )
+        else:
+            values, new_tabs, new_ptabs = seg_fn(
+                params, packed_cat, buckets_cat, parents_cat,
+                None if material_cat is None else material_cat.reshape(-1),
+                stacked, seg_rows, pstacked,
+            )
         # Per-segment wire accounting: each segment ships its tier of
         # rows plus its entry scalars — the same formula as a solo
         # dispatch at (size, tier), so the split is exact.
         seg_feature_bytes = tier * 2 * 8 * 2 + size * 2 * 4 + 4
         seg_material_bytes = 0 if material_cat is None else size * 4
-        shared = _FusedValues(values, dups=dups_flat)
+        shared = _FusedValues(values, dups=dups_flat, fills=fills_flat)
         for k, tk in enumerate(tickets):
             g = tk.group
             # Donation rebind: index g is only ever touched by the
@@ -2587,15 +2805,29 @@ class SearchService:
             )
             for g in groups
         }
+        # Position-keyed eval reuse (doc/eval-cache.md): probe the
+        # process-wide cache between step and dispatch; insert at
+        # provide time. None = FISHNET_NO_EVAL_CACHE or non-packed wire.
+        cache = self._eval_cache
+        # Cache keys are (Zobrist ^ network fingerprint); raw hashes
+        # still feed the pool TT fills and the segment-dedup planner.
+        salt = self._cache_salt
+        hash_ptrs = {
+            g: self._hash_buf[g].ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint64)
+            )
+            for g in groups
+        }
         # In-flight device evals per group: group -> (n, dispatched
-        # array or ticket, device_step trace context or None).
+        # array or ticket, device_step trace context or None, batch
+        # Zobrist hashes or None, cache-hit mask or None).
         # The software pipeline: resolve group g's previous eval (blocks
         # only on the oldest dispatch), wake its fibers, step them to new
         # leaves, dispatch the next eval — then move to group g+1 while
         # this one rides the host<->device link. With k groups per thread
         # up to k batches overlap CPU search, transfer, and device
         # compute — and T threads' CPU phases overlap each other.
-        inflight: Dict[int, Tuple[int, object, object]] = {}
+        inflight: Dict[int, Tuple[int, object, object, object, object]] = {}
 
         # Compile every eval-size bucket up front (first thread compiles,
         # the rest block on the shared warmup lock): a first-touch XLA
@@ -2679,7 +2911,7 @@ class SearchService:
             stepped = 0
             for g in groups:
                 if g in inflight:
-                    n_prev, handle, dctx = inflight.pop(g)
+                    n_prev, handle, dctx, hb, hmask = inflight.pop(g)
                     t0 = time.monotonic() if tel else 0.0
                     if isinstance(handle, _CoalesceTicket):
                         # Flushes the coalescer if this ticket is still
@@ -2691,6 +2923,20 @@ class SearchService:
                     else:
                         arr = handle
                     values = self._resolve_eval(n_prev, arr)
+                    if cache is not None and hb is not None:
+                        # Provide-time fill (the ONE insert site every
+                        # rung, the coalescer-off path and the mesh all
+                        # funnel through): teach the process cache this
+                        # batch's evals, and land cache-known values in
+                        # the pool's own TT (fc_pool_tt_fill) so its
+                        # next probe of the position is a tt_eval_hit —
+                        # the pool TT and the cache stay coherent.
+                        cache.insert_block(hb ^ salt, values)
+                        if hmask is not None:
+                            for i in np.nonzero(hmask)[0]:
+                                lib.fc_pool_tt_fill(
+                                    self._pool, int(hb[i]), int(values[i])
+                                )
                     if tel:
                         _SPANS.record(
                             "wire_decode", t0,
@@ -2763,6 +3009,51 @@ class SearchService:
                                 self._degrade_shard_for(g, err)
                     t0 = time.monotonic() if tel else 0.0
                     dctx = step_ctx.child() if step_ctx is not None else None
+                    # PRE-DISPATCH CACHE PROBE (doc/eval-cache.md):
+                    # export the batch's Zobrist hashes and ask the
+                    # process-wide cache. Every entry known -> the
+                    # dispatch is skipped outright (values resolve
+                    # host-side; the pool's device anchors are
+                    # invalidated first so later blocks reseed instead
+                    # of delta-ing against rows this batch never
+                    # wrote). Partial hits ride the ticket into the
+                    # fused planner, which drops what it can.
+                    hashes = hmask = hvals = None
+                    if cache is not None:
+                        t0c = time.monotonic() if tel else 0.0
+                        lib.fc_pool_batch_hashes(
+                            self._pool, g, hash_ptrs[g],
+                            self._group_capacity,
+                        )
+                        hashes = self._hash_buf[g][:n]
+                        hvals, hmask = cache.probe_block(
+                            hashes ^ salt, out=self._cache_val_buf[g][:n]
+                        )
+                        hits = int(hmask.sum())
+                        if tel:
+                            _SPANS.record(
+                                "cache_probe", t0c, trace=dctx,
+                                group=g, n=n, hits=hits,
+                            )
+                        self._miss_hist.record(g, hits, n)
+                        if self._cache_steer:
+                            self._steer_prefetch(g)
+                        if hits == n:
+                            lib.fc_pool_cancel_anchors(self._pool, g)
+                            with self._lock:
+                                self._cache_prewire_hits += n
+                                self._cache_skipped_dispatches += 1
+                            inflight[g] = (
+                                n,
+                                np.array(hvals[:n], copy=True),
+                                dctx, hashes, hmask,
+                            )
+                            if tel:
+                                _SPANS.record(
+                                    "device_step", t0, trace=dctx,
+                                    group=g, n=n, cache_skip=1,
+                                )
+                            continue
                     if self._coalescer is not None:
                         # Park the microbatch with the coalescer; it
                         # dispatches fused with other ready groups (or
@@ -2770,14 +3061,16 @@ class SearchService:
                         inflight[g] = (
                             n,
                             self._coalescer.submit(
-                                g, n, rows.value, trace=dctx
+                                g, n, rows.value, trace=dctx,
+                                hashes=hashes, cache_mask=hmask,
+                                cache_vals=hvals,
                             ),
-                            dctx,
+                            dctx, hashes, hmask,
                         )
                     else:
                         values, acct = self._dispatch_eval(g, n, rows.value)
                         self._apply_acct(t, acct)
-                        inflight[g] = (n, values, dctx)
+                        inflight[g] = (n, values, dctx, hashes, hmask)
                     if tel:
                         _SPANS.record(
                             "device_step", t0, trace=dctx, group=g, n=n

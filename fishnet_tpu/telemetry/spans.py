@@ -77,7 +77,7 @@ STAGES = (
 #: Event stages: recorded only when the named machinery runs.
 EVENT_STAGES = (
     "recover", "coalesce", "dispatch_issue", "dispatch_wait",
-    "queue_wait", "submit", "admit",
+    "queue_wait", "submit", "admit", "cache_probe",
 )
 
 #: Span-dump header format. /2 added the additive causal-trace fields

@@ -130,3 +130,37 @@ class LeakyShardRouter:
     async def degrade(self):
         with self._lock:
             self._rungs[0] += 1
+
+
+class StripedCachePattern:
+    """The process-wide eval-reuse plane (search/eval_cache.EvalCache):
+    provide-time writers on driver threads and async probers share
+    lock-striped buckets; every stripe access holds its stripe's lock.
+    Must be clean."""
+
+    def __init__(self):
+        self._locks = [threading.Lock(), threading.Lock()]
+        self._stripes = [{}, {}]
+        self._drive = threading.Thread(target=self._insert_loop)
+
+    def _insert_loop(self):
+        with self._locks[0]:
+            self._stripes[0][0] = 1  # guarded striped insert: fine
+
+    async def probe(self, key):
+        with self._locks[0]:
+            return self._stripes[0].get(key)
+
+
+class LeakyStripedCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = 0
+        self._drive = threading.Thread(target=self._insert_loop)
+
+    def _insert_loop(self):
+        self._entries += 1  # VIOLATION: unguarded vs probe's guarded bump
+
+    async def probe(self, key):
+        with self._lock:
+            self._entries += 1

@@ -40,3 +40,18 @@ def pytest_configure(config):
 def anyio_backend():
     # aiohttp requires asyncio; never run async tests on trio.
     return "asyncio"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_eval_cache():
+    # The position-keyed eval cache is process-wide BY DESIGN (it
+    # outlives services to survive respawns), which in a shared pytest
+    # process would couple tests: a warm cache turns later tests'
+    # dispatches into whole-batch skips and skews every dispatch-count
+    # assertion. Reset around each test; warm-cache behavior is
+    # exercised explicitly inside tests/test_eval_cache.py.
+    from fishnet_tpu.search import eval_cache
+
+    eval_cache.reset_cache()
+    yield
+    eval_cache.reset_cache()

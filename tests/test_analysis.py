@@ -136,6 +136,7 @@ def test_r4_fires_on_known_lines():
         ("R4", 32),  # self._stopping unguarded in driver thread
         ("R4", 91),  # LeakyPipeline._seq unguarded in pack worker
         ("R4", 128),  # LeakyShardRouter._rungs unguarded ladder step
+        ("R4", 162),  # LeakyStripedCache._entries unguarded insert
     ]
 
 
@@ -172,6 +173,22 @@ def test_r4_shard_router_pattern():
     assert not any("_assign" in f.message for f in findings)
     assert any(
         "LeakyShardRouter" in f.message and "_rungs" in f.message
+        for f in findings
+    )
+
+
+def test_r4_striped_cache_pattern():
+    """The lock-striped eval-cache shape (search/eval_cache.EvalCache):
+    driver-thread inserts and async probes sharing striped buckets are
+    clean when every access holds the stripe lock; the same shape with
+    an unguarded thread-side insert is flagged."""
+    findings = check_paths(
+        [FIXTURES / "r4_cross_thread.py"], [CrossThreadStateRule()]
+    )
+    assert not any("StripedCachePattern" in f.message for f in findings)
+    assert not any("_stripes" in f.message for f in findings)
+    assert any(
+        "LeakyStripedCache" in f.message and "_entries" in f.message
         for f in findings
     )
 

@@ -74,6 +74,12 @@ def _smoke_run(weights, fens=None, nodes=200, psqt_path=None, mutate=None):
     # nodes still drives multi-group coalesced traffic through every
     # entry kind while a full smoke stays well under 10 s on one core.
     fens = _SMOKE_FENS[:6] if fens is None else fens
+    from fishnet_tpu.search import eval_cache
+
+    # Cold-start the process eval cache: back-to-back runs of the same
+    # FENs would otherwise whole-batch-skip dispatches and skew the
+    # eval_steps/overlap comparisons (analyses stay bit-identical).
+    eval_cache.reset_cache()
     svc = _GatedService(
         weights=weights, pool_slots=8, batch_capacity=256,
         tt_bytes=8 << 20, backend="jax", pipeline_depth=4,

@@ -347,6 +347,13 @@ class _GatedService(SearchService):
 
 
 def _smoke_run(weights):
+    from fishnet_tpu.search import eval_cache
+
+    # Cold-start the process eval cache per run: back-to-back runs of
+    # the same FENs would otherwise whole-batch-skip their dispatches
+    # (bit-identical output, but the dispatch-count assertions compare
+    # coalescer behavior, not cache behavior).
+    eval_cache.reset_cache()
     svc = _GatedService(
         weights=weights, pool_slots=8, batch_capacity=256,
         tt_bytes=8 << 20, backend="jax", pipeline_depth=4,

@@ -406,7 +406,14 @@ def _mesh_smoke(weights, mesh_devices):
     from test_coalesce import _SMOKE_FENS, _GatedService
 
     from fishnet_tpu.resilience import accounting
+    from fishnet_tpu.search import eval_cache
 
+    # Each smoke run cold-starts the process eval cache: consecutive
+    # runs serve the SAME positions, and a warm cache would turn the
+    # later services' dispatches into whole-batch skips — parity would
+    # still hold (that's the cache's contract) but the traffic-spread
+    # assertions below would see zero per-shard dispatches.
+    eval_cache.reset_cache()
     svc = _GatedService(
         weights=weights, pool_slots=8, batch_capacity=256,
         tt_bytes=8 << 20, backend="jax", pipeline_depth=4,
