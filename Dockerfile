@@ -21,4 +21,10 @@ COPY --from=builder /build/cpp/libfishnetcore-v3.so cpp/libfishnetcore-v3.so
 COPY --from=builder /build/cpp/libfishnetcore-v4.so cpp/libfishnetcore-v4.so
 COPY docker-entrypoint.sh /docker-entrypoint.sh
 RUN chmod +x /docker-entrypoint.sh
+# `docker stop` must trigger the client's graceful drain (SIGTERM ->
+# flush in-flight batches within --drain-deadline, abort the rest
+# upstream, exit 0). The entrypoint execs python as pid 1 so the signal
+# lands on the client; give the stop grace period headroom over the
+# drain deadline (docker stop -t 40 with the default 25 s deadline).
+STOPSIGNAL SIGTERM
 CMD ["/docker-entrypoint.sh"]

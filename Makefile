@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
@@ -76,6 +76,15 @@ multichip-smoke:
 cache-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_eval_cache.py -q \
 		-k "parity and not mesh"
+
+# Fleet crash-tolerance contract (doc/resilience.md "Fleet chaos",
+# ≤60 s): real client processes behind chaos proxies — a SIGKILL, a
+# SIGTERM drain (exit 0), a partition window — restart under budget,
+# the server-side fleet ledger exactly-once (0 lost / 0 duplicated),
+# and the fleet metric families on /metrics.
+cluster-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cluster.py -q \
+		-k "smoke or drain"
 
 # Causal-tracing contract (doc/observability.md "Causal tracing",
 # ≤60 s): a gated mock-server run must yield complete span trees (zero

@@ -19,5 +19,10 @@ if [ -n "$MICROBATCH" ]; then args+=("--microbatch" "$MICROBATCH"); fi
 if [ -n "$PIPELINE" ]; then args+=("--pipeline" "$PIPELINE"); fi
 if [ -n "$SEARCH_THREADS" ]; then args+=("--search-threads" "$SEARCH_THREADS"); fi
 if [ -n "$MESH" ]; then args+=("--mesh" "$MESH"); fi
+if [ -n "$DRAIN_DEADLINE" ]; then args+=("--drain-deadline" "$DRAIN_DEADLINE"); fi
 
+# exec, not a child shell: the client must BE pid 1 so `docker stop`'s
+# SIGTERM (STOPSIGNAL in the Dockerfile) reaches it and triggers the
+# graceful drain — flush in-flight batches, abort the rest upstream,
+# exit 0 — instead of dying unflushed with the shell.
 exec python -m fishnet_tpu "${args[@]}"

@@ -300,6 +300,8 @@ def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
 async def run_client(opt: Opt, logger: Logger) -> None:
     """The supervisor loop (main.rs:76-260)."""
     from fishnet_tpu.client import Client
+    from fishnet_tpu.resilience import drain
+    from fishnet_tpu.search import eval_cache as eval_cache_mod
 
     from pathlib import Path
 
@@ -342,6 +344,19 @@ async def run_client(opt: Opt, logger: Logger) -> None:
             "Never run this against production traffic."
         )
 
+    # Warm-restart snapshot (FISHNET_EVAL_CACHE_SNAPSHOT): reload the
+    # previous process's eval cache so the first warm batches resolve
+    # pre-wire. The net fingerprint keys the snapshot to the serving
+    # weights — a mismatch discards it cleanly (doc/eval-cache.md).
+    net_fp = (
+        eval_cache_mod.net_fingerprint(opt.nnue_file) if opt.nnue_file else 0
+    )
+    if eval_cache_mod.snapshot_path() is not None:
+        if eval_cache_mod.load_snapshot(fingerprint=net_fp):
+            cache = eval_cache_mod.get_cache()
+            n = len(cache) if cache is not None else 0
+            logger.info(f"Restored {n} eval-cache entries from snapshot.")
+
     engine_factory = build_engine_factory(opt, logger)
     shed_policy = None
     if opt.lane_depth_limit is not None:
@@ -380,20 +395,49 @@ async def run_client(opt: Opt, logger: Logger) -> None:
 
     stop = asyncio.Event()
     sigints = 0
+    sigterms = 0
+    drain_guard: Optional[asyncio.Task] = None
 
     def on_sigint() -> None:
         nonlocal sigints
         sigints += 1
         if sigints == 1:
             logger.fishnet_info("Stopping soon. Press ^C again to abort pending batches ...")
+            drain.begin("sigint", depth_fn=client.queue_depth)
             client.shutdown_soon()
         else:
             logger.fishnet_info("Stopping now.")
             stop.set()
 
     def on_sigterm() -> None:
-        logger.fishnet_info("Stopping now.")
-        stop.set()
+        # Graceful drain (doc/resilience.md): stop acquiring, flush
+        # in-flight batches until the deadline, then abort the rest
+        # upstream (accounted — the server reassigns) and exit 0.
+        # Readiness (/healthz, /healthz/ready) flips to 503 so an
+        # orchestrator stops routing at this process; liveness
+        # (/healthz/live) stays 200 — draining is not wedged.
+        nonlocal sigterms, drain_guard
+        sigterms += 1
+        if sigterms > 1:
+            logger.fishnet_info("Stopping now.")
+            stop.set()
+            return
+        deadline = opt.resolved_drain_deadline()
+        logger.fishnet_info(
+            f"SIGTERM: draining (flushing in-flight batches, deadline "
+            f"{deadline:.0f}s; send SIGTERM again to abort now) ..."
+        )
+        drain.begin("sigterm", deadline=deadline, depth_fn=client.queue_depth)
+        client.shutdown_soon()
+
+        async def deadline_guard() -> None:
+            await asyncio.sleep(deadline)
+            logger.fishnet_info(
+                "Drain deadline reached; aborting remaining batches upstream."
+            )
+            stop.set()
+
+        drain_guard = asyncio.create_task(deadline_guard())
 
     loop = asyncio.get_running_loop()
     try:
@@ -442,10 +486,16 @@ async def run_client(opt: Opt, logger: Logger) -> None:
     try:
         await asyncio.wait({stop_task, drained_task}, return_when=asyncio.FIRST_COMPLETED)
     finally:
-        for t in (stop_task, drained_task, summary, updater):
+        for t in (stop_task, drained_task, summary, updater, drain_guard):
             if t is not None:
                 t.cancel()
         await client.stop(abort_pending=stop.is_set())
+        # Persist the eval cache for a warm restart (no-op unless
+        # FISHNET_EVAL_CACHE_SNAPSHOT is set). After client.stop so the
+        # snapshot holds the final working set; before engine teardown
+        # so a slow native close can't outlive the write.
+        if eval_cache_mod.snapshot_path() is not None:
+            eval_cache_mod.save_snapshot(fingerprint=net_fp)
         # Tear down shared engine backends before interpreter exit: a
         # daemon driver thread still inside native/JAX code when Python
         # unwinds takes the process down with SIGABRT.
@@ -453,6 +503,11 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         # Flush the (interval-debounced) stats file and stop serving
         # scrapes before teardown completes.
         stats.flush()
+        if drain.draining():
+            from fishnet_tpu import telemetry
+
+            if telemetry.enabled():
+                telemetry.RECORDER.dump(reason="drain")
         if exporter is not None:
             exporter.close()
         logger.fishnet_info(client.stats_summary())
@@ -464,7 +519,7 @@ async def run_client(opt: Opt, logger: Logger) -> None:
     # an in-flight CancelledError). The install lands HERE, once the
     # engines are torn down, so no live process ever has files swapped
     # under it (update.py promote_staged).
-    if restart_to is not None and not stop.is_set() and sigints == 0:
+    if restart_to is not None and not stop.is_set() and sigints == 0 and sigterms == 0:
         from fishnet_tpu.update import (
             default_install_root,
             promote_staged,

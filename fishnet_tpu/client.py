@@ -295,6 +295,13 @@ class Client:
         if self._queue_stub is not None:
             self._queue_stub.shutdown_soon()
 
+    def queue_depth(self) -> Optional[Dict[str, int]]:
+        """Remaining-work snapshot (pending batches/positions/queued) —
+        the drain readiness body's progress report."""
+        if self._queue_stub is None:
+            return None
+        return self._queue_stub.depth()
+
     async def wait_drained(self) -> None:
         """Resolve when workers and queue have exited (i.e. a
         ``shutdown_soon`` drain completed); the api actor stays up to

@@ -1,0 +1,25 @@
+"""Fleet-scale crash tolerance harness.
+
+Everything below this package treats a *process* as the unit of
+failure: the chaos proxy (:mod:`fishnet_tpu.cluster.proxy`) sits
+between each client process and the server and injects partitions,
+latency and 5xx storms; the fleet supervisor
+(:mod:`fishnet_tpu.cluster.supervisor`) spawns real
+``python -m fishnet_tpu`` client processes, kills or drains them on a
+deterministic fault plan, and restarts them under a bounded budget.
+``python -m fishnet_tpu.cluster.chaos`` wires both against the fake
+server and audits the fleet ledger: every work unit handed to any
+process is completed exactly once, across SIGKILL, SIGTERM drain and
+network partitions.
+
+All chaos is driven by the fault-plan grammar
+(:mod:`fishnet_tpu.resilience.faults`) through the fleet sites
+``proxy.partition``, ``proxy.latency``, ``proxy.error5xx``,
+``proc.kill`` and ``proc.sigterm`` — seedable, deterministic,
+documented in doc/resilience.md.
+"""
+
+from fishnet_tpu.cluster.proxy import ChaosProxy
+from fishnet_tpu.cluster.supervisor import FleetSupervisor, ProcSpec
+
+__all__ = ["ChaosProxy", "FleetSupervisor", "ProcSpec"]

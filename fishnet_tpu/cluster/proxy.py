@@ -1,0 +1,177 @@
+"""Chaos proxy: an HTTP forwarder between one client process and the
+server that injects network faults from a fault plan.
+
+One :class:`ChaosProxy` fronts one client process (the fleet supervisor
+gives every process its own proxy + its own plan), so partitions are
+per-link, the way real networks fail. The proxy polls three sites once
+per forwarded request, in this order:
+
+* ``proxy.partition`` — action ``latency=S`` opens an S-second window
+  during which EVERY request is dropped with a connection reset (no
+  HTTP response; the client sees ``ClientConnectionError`` and takes
+  its error-backoff path); action ``error`` drops just the matched
+  request. Window opens increment
+  ``fishnet_fleet_partitions_total{proxy}``.
+* ``proxy.error5xx`` — answer 502 without reaching the server (an LB
+  or gateway failing, as opposed to the link dying).
+* ``proxy.latency`` — action ``latency=S`` delays the matched request
+  S seconds, then forwards it.
+
+The proxy is pure HTTP plumbing: it never parses or rewrites bodies,
+so client/server protocol behavior through a quiet proxy is
+byte-for-byte the direct behavior.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+from urllib.parse import urlsplit
+
+import aiohttp
+from aiohttp import web
+
+from fishnet_tpu import telemetry as _telemetry
+from fishnet_tpu.resilience.faults import FaultPlan
+
+_PARTITIONS = _telemetry.REGISTRY.counter(
+    "fishnet_fleet_partitions_total",
+    "Network partition windows opened by the chaos proxy, per proxy.",
+    labelnames=("proxy",),
+)
+
+#: Request headers the proxy must not blindly copy: Host names the
+#: proxy, and aiohttp recomputes framing headers for the new request.
+_HOP_HEADERS = ("host", "content-length", "transfer-encoding", "connection")
+
+
+class ChaosProxy:
+    """Forward ``http://127.0.0.1:<port><path>`` to ``upstream``,
+    injecting faults per ``plan`` (None = a quiet, faithful proxy).
+
+    ``upstream`` is the full endpoint the client would otherwise use
+    (e.g. ``http://127.0.0.1:43210/fishnet``); :attr:`endpoint` is the
+    same path on the proxy's own ephemeral port, ready to hand to the
+    client's ``--endpoint``.
+    """
+
+    def __init__(
+        self,
+        upstream: str,
+        plan: Optional[FaultPlan] = None,
+        name: str = "proxy",
+    ) -> None:
+        parts = urlsplit(upstream)
+        if parts.scheme not in ("http",) or not parts.netloc:
+            raise ValueError(f"chaos proxy needs an http upstream: {upstream!r}")
+        self._base = f"{parts.scheme}://{parts.netloc}"
+        self._path = parts.path.rstrip("/")
+        self.name = name
+        self.plan = plan
+        self.port = 0
+        self._partition_until = 0.0
+        self._runner: Optional[web.AppRunner] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        # Per-proxy tallies for reports (the counter above is fleet-wide).
+        self.forwarded = 0
+        self.dropped = 0
+        self.injected_5xx = 0
+        self.delayed = 0
+        self.partitions = 0
+
+    @property
+    def endpoint(self) -> str:
+        """The endpoint to hand to the client (proxy port, same path)."""
+        return f"http://127.0.0.1:{self.port}{self._path}"
+
+    async def start(self) -> "ChaosProxy":
+        # Dropped requests die mid-response by design; aiohttp's server
+        # logger reports each one as an unhandled error. Chaos runs are
+        # the only place this proxy exists, so silence that logger
+        # rather than drown the run's own output.
+        logging.getLogger("aiohttp.server").setLevel(logging.CRITICAL)
+        self._session = aiohttp.ClientSession()
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    def stats(self):
+        return {
+            "forwarded": self.forwarded,
+            "dropped": self.dropped,
+            "injected_5xx": self.injected_5xx,
+            "delayed": self.delayed,
+            "partitions": self.partitions,
+        }
+
+    def _drop(self, request: web.Request) -> web.Response:
+        """Connection reset: close the transport under the in-flight
+        request so the client sees the link die, not an HTTP status."""
+        self.dropped += 1
+        transport = request.transport
+        if transport is not None:
+            transport.close()
+        # Never reaches the wire (transport is closing); returning a
+        # response keeps aiohttp's handler machinery on its happy path.
+        return web.Response(status=502, text="partitioned\n")
+
+    async def _handle(self, request: web.Request) -> web.Response:
+        now = time.monotonic()
+        if now < self._partition_until:
+            return self._drop(request)
+        plan = self.plan
+        if plan is not None:
+            rule = plan.poll("proxy.partition")
+            if rule is not None:
+                if rule.action == "latency" and rule.arg > 0:
+                    self._partition_until = now + rule.arg
+                self.partitions += 1
+                _PARTITIONS.inc(proxy=self.name)
+                return self._drop(request)
+            rule = plan.poll("proxy.error5xx")
+            if rule is not None:
+                self.injected_5xx += 1
+                return web.Response(status=502, text="chaos proxy: injected 502\n")
+            rule = plan.poll("proxy.latency")
+            if rule is not None and rule.arg > 0:
+                self.delayed += 1
+                await asyncio.sleep(rule.arg)
+        body = await request.read()
+        headers = {
+            k: v
+            for k, v in request.headers.items()
+            if k.lower() not in _HOP_HEADERS
+        }
+        url = self._base + request.rel_url.path_qs
+        try:
+            async with self._session.request(
+                request.method, url, data=body, headers=headers
+            ) as resp:
+                payload = await resp.read()
+                out_headers = {}
+                if "Content-Type" in resp.headers:
+                    out_headers["Content-Type"] = resp.headers["Content-Type"]
+                self.forwarded += 1
+                return web.Response(
+                    status=resp.status, body=payload, headers=out_headers
+                )
+        except aiohttp.ClientError:
+            # Upstream itself is down/unreachable: surface as a 502 so
+            # the client backs off the same way it would behind a real
+            # gateway.
+            return web.Response(status=502, text="chaos proxy: upstream error\n")

@@ -231,9 +231,18 @@ class Opt:
     #: positions past which analysis batches are shed (accounted abort;
     #: the server reassigns). None = the shed policy default.
     lane_depth_limit: Optional[int] = None
+    #: Graceful-drain deadline in seconds (doc/resilience.md "Graceful
+    #: drain"): on SIGTERM the client stops acquiring and flushes
+    #: in-flight batches for at most this long before aborting the rest
+    #: upstream and exiting 0. None = the 25 s default (chosen to fit
+    #: under Kubernetes' 30 s terminationGracePeriodSeconds).
+    drain_deadline: Optional[float] = None
 
     def resolved_tenants(self) -> int:
         return self.tenants if self.tenants is not None else 1
+
+    def resolved_drain_deadline(self) -> float:
+        return self.drain_deadline if self.drain_deadline is not None else 25.0
 
     def conf_path(self) -> Path:
         return Path(self.conf) if self.conf else Path("fishnet.ini")
@@ -358,6 +367,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "analysis-lane positions past which bulk batches "
                         "are shed (accounted abort; the server reassigns). "
                         "Default: the shed policy's built-in watermark.")
+    p.add_argument("--drain-deadline", default=None,
+                   help="Graceful-drain deadline (duration, e.g. 25s): on "
+                        "SIGTERM, flush in-flight batches for at most this "
+                        "long before aborting the rest upstream (accounted; "
+                        "the server reassigns) and exiting 0. Default: 25s.")
     return p
 
 
@@ -423,6 +437,10 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
         if ns.lane_depth_limit < 1:
             raise ConfigError("--lane-depth-limit must be >= 1")
         opt.lane_depth_limit = ns.lane_depth_limit
+    if ns.drain_deadline is not None:
+        opt.drain_deadline = parse_duration(ns.drain_deadline)
+        if opt.drain_deadline <= 0:
+            raise ConfigError("--drain-deadline must be positive")
     return opt
 
 
@@ -476,6 +494,7 @@ _INI_FIELDS = (
     ("Tenants", "tenants", lambda v: _positive_int(v, "Tenants")),
     ("LaneDepthLimit", "lane_depth_limit",
      lambda v: _positive_int(v, "LaneDepthLimit")),
+    ("DrainDeadline", "drain_deadline", parse_duration),
 )
 
 

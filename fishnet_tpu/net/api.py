@@ -111,6 +111,13 @@ _ACQUIRE_PACED = _telemetry.REGISTRY.counter(
     "shedding; pulling more bulk work would only be aborted back).",
     labelnames=("tenant",),
 )
+_CONN_RESETS = _telemetry.REGISTRY.counter(
+    "fishnet_api_conn_resets_total",
+    "Requests that died to a connection-level failure (reset, refused, "
+    "dropped mid-flight) rather than an HTTP error — the client-side "
+    "signature of a network partition.",
+    labelnames=("endpoint",),
+)
 
 #: Acquire-stream pause per pacing round while the shed policy is
 #: active. Long enough to let the queue drain meaningfully, short
@@ -463,6 +470,10 @@ class ApiActor:
                 time.monotonic() - started, endpoint=msg.kind
             )
             _REQUESTS.inc(endpoint=msg.kind, outcome="error")
+            if isinstance(
+                err, (aiohttp.ClientConnectionError, asyncio.TimeoutError)
+            ):
+                _CONN_RESETS.inc(endpoint=msg.kind)
             if msg.kind == "submit_analysis" and self.breaker.record_failure():
                 self.logger.error(
                     "Submit circuit breaker OPEN: parking submissions for "
@@ -589,6 +600,21 @@ class ApiActor:
             ) as res:
                 if res.status == 429:
                     raise RateLimited()
+                if res.status == 404:
+                    # Fenced: the server no longer recognizes this work
+                    # — its timeout sweep reassigned it while we were
+                    # partitioned or slow, or another process already
+                    # completed it. Retrying can only duplicate work.
+                    _REJECTS.inc(endpoint="submit_analysis", status="404")
+                    self.logger.warn(
+                        f"Work {msg.batch_id} no longer ours (404); "
+                        "dropping submission."
+                    )
+                    if msg.final:
+                        led = _accounting.get()
+                        if led is not None:
+                            led.record_abandoned(msg.batch_id, "fenced")
+                    return
                 res.raise_for_status()
                 if res.status != 204:
                     self.logger.warn(
@@ -605,6 +631,20 @@ class ApiActor:
             ) as res:
                 if res.status == 429:
                     raise RateLimited()
+                if res.status == 404:
+                    # Fenced move (see submit_analysis): the work was
+                    # reassigned or already completed — drop it and let
+                    # the normal acquire loop fetch fresh work.
+                    _REJECTS.inc(endpoint="submit_move", status="404")
+                    self.logger.warn(
+                        f"Work {msg.batch_id} no longer ours (404); "
+                        "dropping move."
+                    )
+                    led = _accounting.get()
+                    if led is not None:
+                        led.record_abandoned(msg.batch_id, "fenced")
+                    self._fulfil(msg, Acquired.no_content())
+                    return
                 rejected = res.status in (400, 401, 403, 406)
                 await self._parse_acquired(res, msg)
                 led = _accounting.get()

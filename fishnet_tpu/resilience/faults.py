@@ -13,7 +13,9 @@ Plan grammar (also doc/resilience.md)::
     plan    := clause (';' clause)*
     clause  := 'seed=' INT | site ':' trigger ':' action
     site    := net.acquire | net.submit | engine.spawn
-             | service.device_step | queue.schedule
+             | service.device_step | queue.schedule | queue.admit
+             | proxy.partition | proxy.latency | proxy.error5xx
+             | proc.kill | proc.sigterm
     trigger := 'nth=' N | 'nth=' A '..' B     -- 1-based call index
              | 'every=' N                     -- every Nth call
              | 'p=' FLOAT                     -- per-call probability
@@ -25,6 +27,16 @@ Plan grammar (also doc/resilience.md)::
                                               -- deadline fires)
 
 Example: ``seed=7;net.acquire:nth=2..3:error;service.device_step:nth=1:crash``.
+
+Fleet sites (cluster chaos, fishnet_tpu/cluster/): the chaos proxy
+polls ``proxy.latency:T:latency=S`` (delay one forwarded request S
+seconds), ``proxy.error5xx:T:error`` (answer 502 without reaching the
+server) and ``proxy.partition:T:latency=S`` (drop EVERY request —
+connection reset, no HTTP response — for a window of S seconds; action
+``error`` drops just the matched request) once per forwarded request;
+the fleet supervisor polls ``proc.kill:T:crash`` (SIGKILL) and
+``proc.sigterm:T:error`` (SIGTERM → graceful drain) once per monitor
+tick per process, so ``nth=N`` means that process's Nth tick.
 
 Determinism: ``nth``/``every`` triggers depend only on the per-site
 call count; ``p`` triggers draw from the plan's own seeded RNG, so a
@@ -51,6 +63,14 @@ from fishnet_tpu import telemetry as _telemetry
 
 #: The injection-site registry. Site names are a contract
 #: (doc/resilience.md); plans naming an unknown site fail to parse.
+#:
+#: The ``proxy.*`` and ``proc.*`` sites are FLEET sites: they are not
+#: ``fire()`` call sites inside this process but are *polled* by the
+#: cluster chaos layer (fishnet_tpu/cluster/) — the chaos proxy polls
+#: the ``proxy.*`` sites once per forwarded request, and the fleet
+#: supervisor polls the ``proc.*`` sites once per monitor tick per
+#: process — so partitions, slow links, 5xx storms and SIGKILL/SIGTERM
+#: are deterministic, seedable plan entries like every in-process fault.
 SITES = (
     "net.acquire",
     "net.submit",
@@ -58,6 +78,11 @@ SITES = (
     "service.device_step",
     "queue.schedule",
     "queue.admit",
+    "proxy.partition",
+    "proxy.latency",
+    "proxy.error5xx",
+    "proc.kill",
+    "proc.sigterm",
 )
 
 ACTIONS = ("error", "crash", "latency", "hang")

@@ -310,12 +310,17 @@ async def run_soak(
         await _phase_a_client(fake_server_mod, logger, report)
         await _phase_b_service(logger, report)
         ab_fault_counts = faults.current().counts()
+        # Snapshot the ledger BEFORE phase C: its saturation traffic
+        # shares the process-wide ledger, so "submitted == phase A
+        # jobs" only holds on this pre-C view.
+        report["ledger"] = ledger.assert_clean()
         # Phase C runs under its own plan (admission + submit faults);
         # the A/B counts are captured above so the report keeps both.
         faults.install(PHASE_C_PLAN)
         await _phase_c_overload(fake_server_mod, logger, report)
 
-        report["ledger"] = ledger.assert_clean()
+        # Whole-run exactly-once, phase C's overload traffic included.
+        report["ledger_final"] = ledger.assert_clean()
         report["counters"] = {
             "faults_injected": ab_fault_counts,
             "requeued": queue_mod._REQUEUED.value() - base["requeued"],

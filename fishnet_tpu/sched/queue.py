@@ -920,6 +920,17 @@ class QueueStub:
         # drop them too so the drain check sees an empty queue.
         self._state.incoming_clear()
 
+    def depth(self) -> Dict[str, int]:
+        """Remaining-work snapshot (graceful drain's readiness body,
+        resilience/drain.py): batches still pending, their unanalysed
+        positions, and positions queued for worker pull."""
+        state = self._state
+        return {
+            "batches": len(state.pending),
+            "positions": sum(b.pending() for b in state.pending.values()),
+            "queued": state.incoming_len(),
+        }
+
     def stats(self) -> Tuple[Stats, NpsRecorder]:
         return (
             self._state.stats_recorder.stats,

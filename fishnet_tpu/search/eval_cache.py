@@ -54,6 +54,22 @@ def cache_disabled() -> bool:
     return os.environ.get("FISHNET_NO_EVAL_CACHE", "") == "1"
 
 
+#: Warm-restart snapshot file (doc/resilience.md "Graceful drain"): when
+#: set, the client persists the cache here on drain and reloads it at
+#: startup, so a restarted process's first batches resolve pre-wire
+#: instead of paying the cold-cache dispatches again.
+SNAPSHOT_ENV = "FISHNET_EVAL_CACHE_SNAPSHOT"
+
+#: Snapshot format version; a mismatch discards the file like a
+#: fingerprint mismatch does.
+SNAPSHOT_VERSION = 1
+
+
+def snapshot_path() -> Optional[str]:
+    """The configured snapshot file, or None (snapshots off)."""
+    return os.environ.get(SNAPSHOT_ENV) or None
+
+
 def net_fingerprint(path: str) -> int:
     """64-bit blake2b of the ``.nnue`` file — the network-identity salt
     the service XORs into every cache key. Positions only collide with
@@ -232,6 +248,52 @@ class EvalCache:
             with self._locks[s]:
                 self._stripes[s].clear()
 
+    # -- snapshot (warm restart) ------------------------------------------
+
+    def dump_entries(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """All entries as ``(hashes, values, generations)`` arrays.
+        Stripe-by-stripe under each stripe's lock — concurrent inserts
+        land in the snapshot or not, either is a valid snapshot."""
+        hashes: List[int] = []
+        values: List[int] = []
+        gens: List[int] = []
+        for s in range(self._n_stripes):
+            with self._locks[s]:
+                for h, (v, g) in self._stripes[s].items():
+                    hashes.append(h)
+                    values.append(v)
+                    gens.append(g)
+        return (
+            np.array(hashes, dtype=np.uint64),
+            np.array(values, dtype=np.int32),
+            np.array(gens, dtype=np.int64),
+        )
+
+    def load_entries(
+        self,
+        hashes: np.ndarray,
+        values: np.ndarray,
+        gens: np.ndarray,
+    ) -> int:
+        """Restore dumped entries (normal eviction applies if they
+        exceed capacity). The generation clock advances to at least the
+        newest restored generation so eviction ordering stays sane."""
+        n = min(len(hashes), len(values), len(gens))
+        top = 0
+        for i in range(n):
+            h = int(hashes[i])
+            g = int(gens[i])
+            top = max(top, g)
+            s = self._stripe_of(h)
+            with self._locks[s]:
+                stripe = self._stripes[s]
+                if h not in stripe and len(stripe) >= self._stripe_cap:
+                    self._evict_locked(s)
+                stripe[h] = (int(values[i]), g)
+        with self._meta_lock:
+            self._generation = max(self._generation, top)
+        return n
+
 
 # -- process-wide singleton -----------------------------------------------
 
@@ -290,6 +352,89 @@ def reset_cache() -> None:
     global _global_cache
     with _global_lock:
         _global_cache = None
+
+
+# -- warm-restart snapshot --------------------------------------------------
+
+
+def save_snapshot(
+    path: Optional[str] = None, fingerprint: int = 0
+) -> Optional[str]:
+    """Persist the process cache to ``path`` (default: the
+    ``FISHNET_EVAL_CACHE_SNAPSHOT`` file; None with neither = no-op).
+    ``fingerprint`` is the serving net's identity
+    (:func:`net_fingerprint`; 0 for dev-mode random weights) — a
+    restart onto different weights must NOT read this snapshot's evals,
+    so :func:`load_snapshot` discards on mismatch. Atomic
+    (tmp + rename): a SIGKILL mid-write leaves the previous snapshot
+    intact, never a torn file. Returns the path written, or None."""
+    path = path or snapshot_path()
+    if path is None:
+        return None
+    cache = _global_cache
+    if cache is None:
+        return None
+    hashes, values, gens = cache.dump_entries()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        # Open explicitly: np.savez appends ".npz" to bare paths, which
+        # would break the rename.
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                version=np.int64(SNAPSHOT_VERSION),
+                fingerprint=np.uint64(fingerprint & ((1 << 64) - 1)),
+                generation=np.int64(cache.stats()["generation"]),
+                hashes=hashes,
+                values=values,
+                gens=gens,
+            )
+        os.replace(tmp, path)
+    except OSError:
+        # Snapshotting is an optimization, never a liveness dependency.
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return None
+    return path
+
+
+def load_snapshot(
+    path: Optional[str] = None, fingerprint: int = 0
+) -> bool:
+    """Restore a snapshot into the process cache. Returns True when
+    entries were restored. A version or fingerprint mismatch (or a
+    corrupt file) DISCARDS the snapshot — the file is removed so a
+    process that upgraded its net doesn't retry the stale snapshot on
+    every restart — and returns False."""
+    import zipfile
+
+    path = path or snapshot_path()
+    if path is None or not os.path.exists(path):
+        return False
+    cache = get_cache()
+    if cache is None:
+        return False
+    try:
+        with np.load(path) as data:
+            version = int(data["version"])
+            snap_fp = int(data["fingerprint"])
+            if version != SNAPSHOT_VERSION or snap_fp != (
+                fingerprint & ((1 << 64) - 1)
+            ):
+                raise ValueError("snapshot version/fingerprint mismatch")
+            cache.load_entries(data["hashes"], data["values"], data["gens"])
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return False
+    return True
 
 
 class MissHistory:

@@ -103,8 +103,20 @@ def _exec_start(opt: Opt, *, absolute: bool) -> str:
         args += ["--search-concurrency", str(opt.search_concurrency)]
     if opt.mesh is not None:
         args += ["--mesh", opt.mesh]
+    if opt.drain_deadline is not None:
+        args += ["--drain-deadline", _duration(opt.drain_deadline)]
 
     return " ".join(args)
+
+
+def _timeout_stop(opt: Opt) -> str:
+    """TimeoutStopSec aligned with the client's graceful drain: systemd
+    sends SIGTERM (KillMode=mixed), the client drains within its
+    deadline (flushing in-flight batches, then aborting the rest
+    upstream) and exits 0 on its own — systemd's SIGKILL must only ever
+    fire after that whole path has had its chance, so deadline + 15 s
+    of margin for the flush/exit tail."""
+    return f"TimeoutStopSec={int(opt.resolved_drain_deadline() + 15)}"
 
 
 def systemd_system(opt: Opt, out: Optional[TextIO] = None) -> None:
@@ -122,6 +134,7 @@ def systemd_system(opt: Opt, out: Optional[TextIO] = None) -> None:
         "[Service]",
         f"ExecStart={_exec_start(opt, absolute=True)} run",
         "KillMode=mixed",
+        _timeout_stop(opt),
         "WorkingDirectory=/tmp",
         f"User={_unit_user()}",
         "Nice=5",
@@ -164,6 +177,7 @@ def systemd_user(opt: Opt, out: Optional[TextIO] = None) -> None:
         "[Service]",
         f"ExecStart={_exec_start(opt, absolute=True)} run",
         "KillMode=mixed",
+        _timeout_stop(opt),
         "WorkingDirectory=/tmp",
         "Nice=5",
         "PrivateTmp=true",

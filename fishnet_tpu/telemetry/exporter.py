@@ -17,11 +17,18 @@ Endpoints:
   the response body at https://ui.perfetto.dev)
 * ``GET /healthz`` — serving-state probe. With no registered health
   providers it is a bare liveness check (200 ``ok``). Serving
-  subsystems (the multi-tenant front end, sched/frontend.py) register
-  providers; the probe then returns a JSON state document — ladder
-  rung, breaker states, shed-active, queue depths — with **503 while
-  shedding or unhealthy**, so a load balancer drains an overloaded
-  worker instead of routing more traffic at it.
+  subsystems (the multi-tenant front end, sched/frontend.py; graceful
+  drain, resilience/drain.py) register providers; the probe then
+  returns a JSON state document — ladder rung, breaker states,
+  shed-active, queue depths, draining — with **503 while shedding,
+  draining, or unhealthy**, so a load balancer drains an overloaded or
+  dying worker instead of routing more traffic at it.
+* ``GET /healthz/ready`` — alias for ``/healthz`` (the readiness half
+  of the liveness-vs-readiness split, spelled the way orchestrator
+  configs expect).
+* ``GET /healthz/live`` — pure liveness: 200 ``ok`` as long as the
+  process is up, **even while draining or shedding** — an orchestrator
+  must not kill a process for being busy dying gracefully.
 """
 
 from __future__ import annotations
@@ -160,7 +167,12 @@ def _make_handler(registry: MetricsRegistry):
 
                     body = json.dumps(chrome_trace(RECORDER.spans())).encode()
                     self._send(200, "application/json", body)
-                elif path == "/healthz":
+                elif path == "/healthz/live":
+                    # Pure liveness: the process is up and the exporter
+                    # thread answers. Never 503s — draining/shedding is
+                    # a READINESS concern (/healthz, /healthz/ready).
+                    self._send(200, "text/plain", b"ok\n")
+                elif path in ("/healthz", "/healthz/ready"):
                     status, health = health_snapshot()
                     if health is None:
                         self._send(200, "text/plain", b"ok\n")

@@ -32,6 +32,9 @@ named machinery actually runs):
   batch, position_id)
 * ``submit``      — the final analysis submission round-trip for a
   completed batch (net/api.py; fields: batch)
+* ``drain``       — the process entered graceful drain: stop acquiring,
+  flush in-flight, abort the rest upstream (resilience/drain.py;
+  fields: reason, deadline_s)
 
 Recording is OFF by default: every instrumentation site is gated on
 ``fishnet_tpu.telemetry.enabled()``, so with telemetry disabled the
@@ -77,7 +80,7 @@ STAGES = (
 #: Event stages: recorded only when the named machinery runs.
 EVENT_STAGES = (
     "recover", "coalesce", "dispatch_issue", "dispatch_wait",
-    "queue_wait", "submit", "admit", "cache_probe",
+    "queue_wait", "submit", "admit", "cache_probe", "drain",
 )
 
 #: Span-dump header format. /2 added the additive causal-trace fields

@@ -27,6 +27,103 @@ class FakeJob:
     body: dict
     user_queue: bool = True
     acquired_by: Optional[str] = None
+    #: Monotonic time of the LAST handout (0 = never handed). Drives the
+    #: server-side reassignment sweep (``FakeLichess.reassign_after``).
+    last_handed: float = 0.0
+
+
+@dataclass
+class FleetUnit:
+    """Per-work-unit audit record: every handout to any process, every
+    completion, every time the server took it back."""
+
+    #: (monotonic time, process key) for each handout.
+    handouts: List = field(default_factory=list)
+    completions: int = 0
+    completed_by: Optional[str] = None
+    #: Times the server re-queued it (client abort or timeout sweep).
+    requeues: List = field(default_factory=list)  # (time, reason)
+    #: Stale submissions the server refused (404): the sweep had
+    #: already re-handed the unit to another process, or it was
+    #: already completed. Fencing is what keeps completions exactly
+    #: once when a partitioned-but-alive process's submit finally
+    #: lands after its work was given away.
+    fences: List = field(default_factory=list)  # (time, proc)
+
+
+class FleetLedger:
+    """Server-side exactly-once audit across PROCESSES — the cross-
+    process twin of ``resilience/accounting.py`` (which lives inside one
+    client and dies with it). Tracks every work unit the server ever
+    handed to any process and answers, after kills / partitions /
+    drains: was anything LOST (handed out, never completed, no longer
+    queued for reassignment) or DUPLICATED (completed more than once)?
+
+    Mutated only from the server's single event loop; readers take
+    snapshots after the run.
+    """
+
+    def __init__(self) -> None:
+        self.units: Dict[str, FleetUnit] = {}
+        #: Successful-handout timestamps per process key — recovery-time
+        #: measurement: first acquire after a restart marks the process
+        #: back at steady state.
+        self.acquires_by_proc: Dict[str, List[float]] = {}
+
+    def record_handed(self, work_id: str, proc: str) -> None:
+        now = time.monotonic()
+        unit = self.units.setdefault(work_id, FleetUnit())
+        unit.handouts.append((now, proc))
+        self.acquires_by_proc.setdefault(proc, []).append(now)
+
+    def record_completed(self, work_id: str, proc: str) -> None:
+        unit = self.units.setdefault(work_id, FleetUnit())
+        unit.completions += 1
+        unit.completed_by = proc
+
+    def record_fenced(self, work_id: str, proc: str) -> None:
+        unit = self.units.setdefault(work_id, FleetUnit())
+        unit.fences.append((time.monotonic(), proc))
+
+    def record_requeued(self, work_id: str, reason: str) -> None:
+        unit = self.units.setdefault(work_id, FleetUnit())
+        unit.requeues.append((time.monotonic(), reason))
+
+    def report(self, open_ids=()) -> Dict[str, object]:
+        """The audit. ``open_ids``: work ids still queued on the server
+        (awaiting reassignment) — handed-but-uncompleted units among
+        them are in flight, not lost."""
+        open_set = set(open_ids)
+        handed = [w for w, u in self.units.items() if u.handouts]
+        lost = [
+            w for w, u in self.units.items()
+            if u.handouts and u.completions == 0 and w not in open_set
+        ]
+        duplicated = [w for w, u in self.units.items() if u.completions > 1]
+        reassigned = [w for w, u in self.units.items() if u.requeues]
+        multi_proc = [
+            w for w, u in self.units.items()
+            if len({p for _, p in u.handouts}) > 1
+        ]
+        return {
+            "handed": len(handed),
+            "completed": sum(
+                1 for u in self.units.values() if u.completions > 0
+            ),
+            "lost": sorted(lost),
+            "duplicated": sorted(duplicated),
+            "reassigned": len(reassigned),
+            "fenced": sum(len(u.fences) for u in self.units.values()),
+            "multi_proc": sorted(multi_proc),
+            "clean": not lost and not duplicated,
+        }
+
+    def assert_clean(self, open_ids=()) -> None:
+        report = self.report(open_ids)
+        assert report["clean"], (
+            f"fleet ledger dirty: lost={report['lost']} "
+            f"duplicated={report['duplicated']}"
+        )
 
 
 @dataclass
@@ -73,6 +170,15 @@ class FakeLichess:
     #: runs several servers against one shared ledger: each server's
     #: counter restarts at 0, so identical prefixes would collide.
     work_id_prefix: str = "wk"
+    #: Cross-process exactly-once audit (cluster tests, bench --cluster).
+    #: Always recorded — it is pure bookkeeping on existing handlers.
+    fleet: FleetLedger = field(default_factory=FleetLedger)
+    #: Server-side reassignment timeout (seconds): an acquired job not
+    #: completed within this window goes back in the queue for another
+    #: process — lila's recovery primitive (doc/protocol.md), and the
+    #: only thing that rescues a SIGKILLed process's work. None = no
+    #: sweep (single-process tests keep the old semantics).
+    reassign_after: Optional[float] = None
     _counter: itertools.count = field(default_factory=itertools.count)
 
     # -- job injection (test side) ---------------------------------------
@@ -165,6 +271,22 @@ class FakeLichess:
             return True
         return False
 
+    def _reassign_stale(self) -> None:
+        """The server-side reassignment sweep: acquired jobs older than
+        ``reassign_after`` go back in the queue. Run at every acquire —
+        the moment another process shows up hungry is exactly when a
+        dead process's work should become available again."""
+        if self.reassign_after is None:
+            return
+        now = time.monotonic()
+        for job in self.jobs:
+            if (
+                job.acquired_by is not None
+                and now - job.last_handed > self.reassign_after
+            ):
+                self.fleet.record_requeued(job.body["work"]["id"], "timeout")
+                job.acquired_by = None
+
     async def handle_acquire(self, request: web.Request) -> web.Response:
         self.acquire_count += 1
         body = await request.json()
@@ -173,13 +295,44 @@ class FakeLichess:
         if not self._check_auth(request, body):
             return web.Response(status=401, text="unknown key")
         slow = request.query.get("slow") == "true"
+        self._reassign_stale()
         self._refill()
         for job in self.jobs:
             if job.acquired_by is None and not (slow and job.user_queue):
-                job.acquired_by = body.get("fishnet", {}).get("apikey", "?")
+                proc = body.get("fishnet", {}).get("apikey", "?")
+                job.acquired_by = proc
+                job.last_handed = time.monotonic()
                 self.handed_at.setdefault(job.body["work"]["id"], time.monotonic())
+                self.fleet.record_handed(job.body["work"]["id"], proc)
                 return web.json_response(job.body, status=202)
         return web.Response(status=204)
+
+    def _fence(self, work_id: str, body: dict) -> Optional[web.Response]:
+        """Exactly-once enforcement: refuse (404, like lila for work it
+        no longer knows) a completion from a process that is not the
+        unit's CURRENT holder — the timeout sweep re-handed it, or it
+        was already completed. Without this, a partitioned-but-alive
+        process's delayed submit lands after the reassignee's and the
+        unit double-completes. A requeued-but-unclaimed unit still
+        accepts its original holder's late submit (the sweep was
+        premature; nobody else did the work)."""
+        proc = body.get("fishnet", {}).get("apikey", "?")
+        job = next(
+            (j for j in self.jobs if j.body["work"]["id"] == work_id), None
+        )
+        stale = (
+            job is None
+            if work_id in self.fleet.units
+            else False
+        ) or (
+            job is not None
+            and job.acquired_by is not None
+            and job.acquired_by != proc
+        )
+        if stale:
+            self.fleet.record_fenced(work_id, proc)
+            return web.Response(status=404, text="unknown work")
+        return None
 
     async def handle_analysis(self, request: web.Request) -> web.Response:
         work_id = request.match_info["id"]
@@ -193,6 +346,9 @@ class FakeLichess:
         if parts and parts[0] is None:
             self.progress_reports.setdefault(work_id, []).append(body)
         else:
+            fenced = self._fence(work_id, body)
+            if fenced is not None:
+                return fenced
             if self.fail_submits > 0:
                 self.fail_submits -= 1
                 return web.Response(status=500, text="injected submit failure")
@@ -201,6 +357,9 @@ class FakeLichess:
             )
             self.analyses[work_id] = body
             self.completed_at.setdefault(work_id, time.monotonic())
+            self.fleet.record_completed(
+                work_id, body.get("fishnet", {}).get("apikey", "?")
+            )
             self.jobs = [j for j in self.jobs if j.body["work"]["id"] != work_id]
         return web.Response(status=204)
 
@@ -209,14 +368,21 @@ class FakeLichess:
         body = await request.json()
         if not self._check_auth(request, body):
             return web.Response(status=401)
+        fenced = self._fence(work_id, body)
+        if fenced is not None:
+            return fenced
         self.moves[work_id] = body
         self.move_done_at.setdefault(work_id, time.monotonic())
+        proc = body.get("fishnet", {}).get("apikey", "?")
+        self.fleet.record_completed(work_id, proc)
         self.jobs = [j for j in self.jobs if j.body["work"]["id"] != work_id]
         # Chained acquire (202 with next job) when available.
         for job in self.jobs:
             if job.acquired_by is None and job.body["work"]["type"] == "move":
-                job.acquired_by = "chained"
+                job.acquired_by = proc
+                job.last_handed = time.monotonic()
                 self.handed_at.setdefault(job.body["work"]["id"], time.monotonic())
+                self.fleet.record_handed(job.body["work"]["id"], proc)
                 return web.json_response(job.body, status=202)
         return web.Response(status=204)
 
@@ -230,6 +396,8 @@ class FakeLichess:
         self.aborted.append(work_id)
         for job in self.jobs:
             if job.body["work"]["id"] == work_id:
+                if job.acquired_by is not None:
+                    self.fleet.record_requeued(work_id, "abort")
                 job.acquired_by = None  # re-queue
         return web.Response(status=204)
 
@@ -257,6 +425,12 @@ class FakeLichess:
         if request.match_info["key"] == VALID_KEY:
             return web.Response(status=200)
         return web.Response(status=404)
+
+    def fleet_report(self) -> Dict[str, object]:
+        """The fleet-ledger audit, with still-queued jobs counted as in
+        flight (awaiting reassignment), not lost."""
+        open_ids = [j.body["work"]["id"] for j in self.jobs]
+        return self.fleet.report(open_ids)
 
     def app(self) -> web.Application:
         app = web.Application()

@@ -116,6 +116,72 @@ def test_net_fingerprint_matches_weights_fingerprint(tmp_path):
     assert NnueWeights.random(seed=6).fingerprint() != w.fingerprint()
 
 
+# -- snapshot persistence (warm restart across process death) --------------
+
+
+def test_eval_cache_snapshot_roundtrip(tmp_path, monkeypatch):
+    """Graceful-drain persistence: save the process cache, simulate
+    process death (reset), restore — entries and the generation clock
+    survive, so the restarted process's first probes hit."""
+    snap = tmp_path / "cache.npz"
+    monkeypatch.setenv(eval_cache.SNAPSHOT_ENV, str(snap))
+    eval_cache.reset_cache()
+    c = eval_cache.get_cache()
+    for h in range(1, 40):
+        c.insert(h * 0x9E3779B9, h)
+    c.advance_generation()
+    c.insert(0xFEED, 123)
+    gen = c.stats()["generation"]
+
+    assert eval_cache.save_snapshot(fingerprint=42) == str(snap)
+    assert snap.exists()
+
+    eval_cache.reset_cache()  # the process died
+    assert eval_cache.load_snapshot(fingerprint=42) is True
+    c2 = eval_cache.get_cache()
+    assert c2.probe(0xFEED) == 123
+    assert c2.probe(7 * 0x9E3779B9) == 7
+    assert c2.stats()["generation"] >= gen
+    eval_cache.reset_cache()
+
+
+def test_eval_cache_snapshot_fingerprint_mismatch_discards(
+    tmp_path, monkeypatch
+):
+    """A snapshot from a DIFFERENT network must be discarded, never
+    half-trusted: evals are only meaningful under the net that produced
+    them (keys are position-hash x net-fingerprint, but the file-level
+    check refuses the whole snapshot up front and deletes it)."""
+    snap = tmp_path / "cache.npz"
+    monkeypatch.setenv(eval_cache.SNAPSHOT_ENV, str(snap))
+    eval_cache.reset_cache()
+    eval_cache.get_cache().insert(0xBEEF, 9)
+    assert eval_cache.save_snapshot(fingerprint=1) == str(snap)
+
+    eval_cache.reset_cache()
+    assert eval_cache.load_snapshot(fingerprint=2) is False
+    assert not snap.exists(), "mismatched snapshot must be deleted"
+    assert eval_cache.get_cache().probe(0xBEEF) is None
+    eval_cache.reset_cache()
+
+
+def test_eval_cache_snapshot_corrupt_file_discards(tmp_path, monkeypatch):
+    snap = tmp_path / "cache.npz"
+    monkeypatch.setenv(eval_cache.SNAPSHOT_ENV, str(snap))
+    snap.write_bytes(b"not a zip archive at all")
+    eval_cache.reset_cache()
+    assert eval_cache.load_snapshot(fingerprint=0) is False
+    assert not snap.exists(), "corrupt snapshot must be deleted"
+    eval_cache.reset_cache()
+
+
+def test_eval_cache_snapshot_disabled_without_env(monkeypatch):
+    monkeypatch.delenv(eval_cache.SNAPSHOT_ENV, raising=False)
+    assert eval_cache.snapshot_path() is None
+    assert eval_cache.save_snapshot() is None
+    assert eval_cache.load_snapshot() is False
+
+
 # -- service integration ---------------------------------------------------
 
 
@@ -198,6 +264,35 @@ def test_cache_parity_and_warm_reuse(rung, monkeypatch):
     assert c_warm["dispatches"] < c_cold["dispatches"], (
         c_warm["dispatches"], c_cold["dispatches"],
     )
+
+
+def test_snapshot_warm_restart_first_batch_resolves_prewire(
+    tmp_path, monkeypatch
+):
+    """The warm-restart contract end to end: run a workload, snapshot
+    the cache (the graceful-drain path), kill the process cache, load
+    the snapshot (the next start), and the restarted service's FIRST
+    warm batch resolves pre-wire — with output bit-identical to the
+    cold run."""
+    snap = tmp_path / "cache.npz"
+    monkeypatch.setenv(eval_cache.SNAPSHOT_ENV, str(snap))
+    weights = NnueWeights.random(seed=13)
+    fp = weights.fingerprint()
+
+    eval_cache.reset_cache()
+    cold, c_cold = _smoke(weights)
+    assert eval_cache.save_snapshot(fingerprint=fp) == str(snap)
+
+    eval_cache.reset_cache()  # process death: the in-memory cache is gone
+    assert eval_cache.load_snapshot(fingerprint=fp) is True
+
+    warm, c_warm = _smoke(weights)
+    assert warm == cold, "snapshot-restored cache changed analysis output"
+    assert c_warm["cache_prewire_hits"] > 0
+    assert c_warm["dispatches"] < c_cold["dispatches"], (
+        c_warm["dispatches"], c_cold["dispatches"],
+    )
+    eval_cache.reset_cache()
 
 
 def test_cache_parity_on_mesh_with_ledger():

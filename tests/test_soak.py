@@ -20,6 +20,9 @@ async def test_soak_canned_plan():
     assert report["ledger"]["lost"] == []
     assert report["ledger"]["duplicated"] == []
     assert report["ledger"]["submitted"] == report["phase_a"]["jobs"]
+    # Whole-run view (phase C's overload traffic included): still clean.
+    assert report["ledger_final"]["lost"] == []
+    assert report["ledger_final"]["duplicated"] == []
     assert all(
         c == 1
         for c in report["phase_a"]["server_submission_counts"].values()

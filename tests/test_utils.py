@@ -161,6 +161,43 @@ def test_systemd_unit_never_emits_placeholder(monkeypatch):
     assert len(user_lines[0]) > len("User=")
 
 
+def test_systemd_unit_timeout_stop_tracks_drain_deadline():
+    """TimeoutStopSec must stay ABOVE the client's drain deadline:
+    systemd's SIGTERM (KillMode=mixed) starts the graceful drain, and
+    its SIGKILL must only fire after the client's own deadline-abort
+    path has had its chance. The unit also reconstructs the
+    --drain-deadline flag so the service drains with the same budget
+    the operator configured."""
+    import io
+
+    from fishnet_tpu import configure as cfg
+    from fishnet_tpu import systemd
+
+    out = io.StringIO()
+    systemd.systemd_system(
+        cfg.Opt(command="systemd", no_conf=True, drain_deadline=40.0), out
+    )
+    text = out.getvalue()
+    assert "TimeoutStopSec=55" in text  # 40s deadline + 15s margin
+    assert "--drain-deadline 40s" in text
+    assert "KillMode=mixed" in text
+
+    # Default (no flag): the 25 s deadline still gets its margin, and
+    # no flag is emitted (the service uses the built-in default).
+    out = io.StringIO()
+    systemd.systemd_user(cfg.Opt(command="systemd-user", no_conf=True), out)
+    text = out.getvalue()
+    assert "TimeoutStopSec=40" in text
+    assert "--drain-deadline" not in text
+
+    # Fractional deadlines round-trip through parse_duration as ms.
+    out = io.StringIO()
+    systemd.systemd_system(
+        cfg.Opt(command="systemd", no_conf=True, drain_deadline=2.5), out
+    )
+    assert "--drain-deadline 2500ms" in out.getvalue()
+
+
 def test_queue_status_bar():
     bar = str(QueueStatusBar(pending=10, cores=4))
     assert bar.startswith("[") and "10" in bar
