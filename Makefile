@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-obs-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
@@ -85,6 +85,17 @@ cache-smoke:
 cluster-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cluster.py -q \
 		-k "smoke or drain"
+
+# Fleet observability contract (doc/observability.md "Fleet
+# observability", ≤45 s): metrics federation with proc labels and
+# staleness (a SIGKILLed process stays in the exposition, marked
+# stale), cross-process trace stitching (reassignment joins, fenced
+# late submits, zero orphans), SLO burn rates over federated series,
+# the span write-ahead journal, and a valid fleet Perfetto export —
+# including the `slow` real-process churn and supervised-fleet tests.
+fleet-obs-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleet_obs.py -q \
+		-m "slow or not slow"
 
 # Causal-tracing contract (doc/observability.md "Causal tracing",
 # ≤60 s): a gated mock-server run must yield complete span trees (zero

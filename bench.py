@@ -740,10 +740,21 @@ SUMMARY_SCHEMA = {
     "cluster": (
         "metric", "value", "unit", "mode", "seconds", "processes",
         "chaos", "latency", "recovery", "drain", "fleet_ledger", "server",
+        "fleet_observability",
     ),
     "cluster.latency": (
         "move_p50_ms", "move_p99_ms", "move_n",
         "analysis_first_p50_ms", "analysis_first_p99_ms", "analysis_n",
+    ),
+    # The fleet observability plane measured DURING the chaos run
+    # (ISSUE 13): federated scrape state per proc, the mid-kill
+    # staleness probe against the live /fleet endpoint, SLO burn rates
+    # from the federated series, cross-process trace stitching, the
+    # fleet critical path (components summing to wall, reassignment
+    # included), and the validated fleet Perfetto export.
+    "cluster.fleet_observability": (
+        "procs", "stale_probe", "slo", "stitch", "critical_path",
+        "perfetto",
     ),
 }
 
@@ -784,6 +795,12 @@ def validate_summary(summary: dict) -> None:
             f"latency.{k}"
             for k in SUMMARY_SCHEMA["cluster.latency"] if k not in lat
         ]
+        obs = summary.get("fleet_observability", {})
+        missing += [
+            f"fleet_observability.{k}"
+            for k in SUMMARY_SCHEMA["cluster.fleet_observability"]
+            if k not in obs
+        ]
         if missing:
             raise ValueError(f"bench summary missing keys: {missing}")
         return
@@ -818,12 +835,12 @@ def validate_summary(summary: dict) -> None:
 
 
 def _percentile(values, q: float):
-    """Nearest-rank percentile (q in [0, 100]); None on no samples."""
-    if not values:
-        return None
-    vs = sorted(values)
-    idx = min(len(vs) - 1, max(0, round(q / 100.0 * (len(vs) - 1))))
-    return vs[idx]
+    """Nearest-rank percentile (q in [0, 100]); None on no samples.
+    Delegates to the one shared definition (telemetry/registry.py) so
+    bench, the fleet console and the SLO engine can't drift apart."""
+    from fishnet_tpu.telemetry.registry import percentile
+
+    return percentile(values, q)
 
 
 #: Overload-mode knobs (all overridable by flag or env).
@@ -1044,14 +1061,24 @@ def run_cluster_bench(
     Headline: p99 of time-to-first-acquire across every process
     (re)spawn, measured at the server — the fleet's return-to-serving
     time after a death."""
+    import urllib.request
+
     from fishnet_tpu.cluster.supervisor import FleetSupervisor, ProcSpec
     from fishnet_tpu.resilience.soak import _load_fake_server
+    from fishnet_tpu.telemetry.fleet import FleetAggregator, port_dir_targets
+    from fishnet_tpu.telemetry.trace_export import validate_chrome_trace
     from fishnet_tpu.utils.logger import Logger
 
     fake = _load_fake_server()
 
     def _r(x):
         return None if x is None else round(x, 1)
+
+    def _http(url: str, timeout: float = 3.0) -> bytes:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            if resp.status != 200:
+                raise RuntimeError(f"{url} -> {resp.status}")
+            return resp.read()
 
     async def drive() -> dict:
         lichess = fake.FakeLichess(require_key=False)
@@ -1065,6 +1092,13 @@ def run_cluster_bench(
             )
             for i in range(procs)
         ]
+        # Realistic in-flight windows: with the instant mock engine a
+        # unit is held for sub-ms, so a SIGKILL almost never strands
+        # work and there is nothing for the server to reassign or the
+        # fleet stitcher to join. 50 ms/position models a real search
+        # and keeps a unit in flight at any kill instant. The children
+        # inherit this through the supervisor's spawn env.
+        _os.environ.setdefault("FISHNET_MOCK_ENGINE_DELAY", "0.05")
         async with fake.FakeServer(lichess) as server:
             supervisor = FleetSupervisor(
                 server.endpoint,
@@ -1074,14 +1108,81 @@ def run_cluster_bench(
                 drain_deadline=drain_deadline,
             )
             await supervisor.start()
+            # Fleet observability plane over the SAME run: the
+            # aggregator discovers the children through the
+            # supervisor's port files (so it follows restarts) and
+            # serves the federated /fleet routes throughout the chaos.
+            aggregator = FleetAggregator(
+                targets_fn=port_dir_targets(str(supervisor.workdir)),
+                poll_interval=0.3,
+                journal_dir=str(supervisor.workdir),
+            ).start()
+            fleet_exporter = aggregator.serve(0)
+
+            def _probe_fleet():
+                doc = json.loads(_http(fleet_exporter.url + "/fleet"))
+                text = _http(fleet_exporter.url + "/metrics").decode()
+                return doc, text
+
             try:
                 t0 = time.monotonic()
+                # Chaos window. After each SIGKILL, probe the live
+                # aggregator ~0.7 s and ~1.2 s later — inside the
+                # stale window before the supervisor's respawned child
+                # re-registers — asserting it still serves /fleet with
+                # the dead proc marked down and its last-known series
+                # still in the federated exposition (no silent drop).
+                stale_probes = []
+                seen_kills = 0
+                pending = []  # (due monotonic, killed proc name)
                 while time.monotonic() - t0 < seconds:
                     await asyncio.sleep(0.25)
+                    kills = [
+                        (t_rel, name)
+                        for t_rel, name, kind in supervisor.events
+                        if kind == "kill"
+                    ]
+                    now = time.monotonic()
+                    for _t_rel, name in kills[seen_kills:]:
+                        pending.append((now + 0.7, name))
+                        pending.append((now + 1.2, name))
+                    seen_kills = len(kills)
+                    for due, name in list(pending):
+                        if now < due:
+                            continue
+                        pending.remove((due, name))
+                        try:
+                            doc, text = await asyncio.to_thread(_probe_fleet)
+                        except Exception as exc:
+                            stale_probes.append({
+                                "proc": name, "served": False,
+                                "error": str(exc),
+                            })
+                            continue
+                        stale_probes.append({
+                            "proc": name,
+                            "served": True,
+                            "stale": sorted(
+                                n for n, st in doc["procs"].items()
+                                if not st["up"]
+                            ),
+                            "dead_series_present": (
+                                f'proc="{name}"' in text
+                            ),
+                        })
+                # Final federation sweep + state doc BEFORE the drain,
+                # while every child still answers /json and /spans.
+                await asyncio.to_thread(aggregator.poll_once)
+                fleet_doc = aggregator.fleet_doc()
+                fleet_trace = json.loads(
+                    _http(fleet_exporter.url + "/fleet/trace", timeout=10)
+                )
                 exit_codes = await supervisor.drain()
             except BaseException:
                 await supervisor.kill_all()
                 raise
+            finally:
+                aggregator.close()
             measured = round(time.monotonic() - t0, 2)
             fleet = lichess.fleet_report()
 
@@ -1127,6 +1228,47 @@ def run_cluster_bench(
                 raise AssertionError(
                     f"post-kill recovery over {recovery_bound_s}s: {slow}"
                 )
+
+            # Fleet observability acceptance (ISSUE 13): the federated
+            # plane must have attributed the run, stitched at least one
+            # killed-and-reassigned unit across processes, and stayed
+            # serving (dead proc stale, series retained) mid-SIGKILL.
+            cp = fleet_doc["critical_path"]
+            if cp["traces"] < 1:
+                raise AssertionError("fleet critical path saw no traces")
+            if cp["coverage"] < 0.95:
+                raise AssertionError(
+                    f"fleet critical-path coverage {cp['coverage']} < 0.95"
+                )
+            proc_names = {f"PROC{i}" for i in range(procs)}
+            if not proc_names <= set(cp["per_proc"]):
+                raise AssertionError(
+                    f"per-proc attribution missing procs: "
+                    f"{sorted(proc_names - set(cp['per_proc']))}"
+                )
+            if len(fleet_doc["stitch"]["cross_proc"]) < 1:
+                raise AssertionError(
+                    "no cross-process stitched trace despite kills: "
+                    f"{fleet_doc['stitch']}"
+                )
+            if not fleet_doc["slo"]:
+                raise AssertionError("SLO engine evaluated nothing")
+            good_probes = [
+                p for p in stale_probes
+                if p.get("served")
+                and p["proc"] in p.get("stale", ())
+                and p.get("dead_series_present")
+            ]
+            if not good_probes:
+                raise AssertionError(
+                    f"no mid-kill probe saw the aggregator serving with "
+                    f"the dead proc stale: {stale_probes}"
+                )
+            validate_chrome_trace(fleet_trace)
+            perfetto_pids = {
+                ev["pid"] for ev in fleet_trace["traceEvents"]
+                if ev.get("ph") == "X"
+            }
 
             li = lichess
             move_lat = [
@@ -1197,6 +1339,29 @@ def run_cluster_bench(
                     "all_zero": not bad_exits,
                 },
                 "fleet_ledger": fleet,
+                "fleet_observability": {
+                    "procs": {
+                        name: {
+                            "up": st["up"],
+                            "scrapes": st["scrapes"],
+                            "errors": st["errors"],
+                            "pids": st["pids"],
+                        }
+                        for name, st in fleet_doc["procs"].items()
+                    },
+                    "stale_probe": {
+                        "probes": stale_probes,
+                        "observed_stale_serving": bool(good_probes),
+                    },
+                    "slo": fleet_doc["slo"],
+                    "stitch": fleet_doc["stitch"],
+                    "critical_path": cp,
+                    "perfetto": {
+                        "events": len(fleet_trace["traceEvents"]),
+                        "track_groups": len(perfetto_pids),
+                        "valid": True,
+                    },
+                },
                 "server": {
                     "acquires": li.acquire_count,
                     "analyses_completed": len(li.analyses),

@@ -293,6 +293,13 @@ def build_engine_factory(opt: Opt, logger: Logger) -> EngineFactory:
     if engine == "mock":
         from fishnet_tpu.engine.mock import MockEngineFactory
 
+        # Per-position artificial latency, for harnesses that need
+        # realistic in-flight windows (a SIGKILL should strand work
+        # mid-unit the way a real multi-second analysis would, not hit
+        # the sub-ms gaps of an instant engine).
+        delay = float(_os.environ.get("FISHNET_MOCK_ENGINE_DELAY", 0) or 0)
+        if delay > 0:
+            return MockEngineFactory(delay_seconds=delay)
         return MockEngineFactory()
     raise ConfigError(f"unknown engine backend: {engine!r}")
 
@@ -331,6 +338,22 @@ async def run_client(opt: Opt, logger: Logger) -> None:
             f"Serving telemetry on http://127.0.0.1:{exporter.port}/metrics "
             "(SIGUSR2 dumps the span flight recorder)."
         )
+        if opt.metrics_port_file is not None:
+            # Written AFTER bind so the port is live when read; atomic
+            # rename so a fleet aggregator polling the file never sees
+            # a half-written number.
+            tmp = f"{opt.metrics_port_file}.tmp"
+            with open(tmp, "w", encoding="utf-8") as fp:
+                fp.write(f"{exporter.port}\n")
+            _os.replace(tmp, opt.metrics_port_file)
+
+    if opt.spans_journal is not None:
+        # Batch-span write-ahead for the fleet stitcher: spans recorded
+        # between the aggregator's last scrape and a SIGKILL survive on
+        # disk; the aggregator tails this file per incarnation.
+        from fishnet_tpu.telemetry.spans import RECORDER as _span_recorder
+
+        _span_recorder.journal_to(opt.spans_journal)
 
     # Deterministic fault injection (--fault-plan / FISHNET_FAULT_PLAN):
     # a testing/soak aid — loudly flagged, never silently active.

@@ -779,7 +779,13 @@ class CrossThreadStateRule:
             for item in node.items:
                 expr = item.context_expr
                 # `with self._lock:` or `with self._lock.acquire…` etc.
-                target = expr.func.value if isinstance(expr, ast.Call) else expr
+                # (`with open(...)` has a Name func — no attr to inspect.)
+                if isinstance(expr, ast.Call):
+                    if not isinstance(expr.func, ast.Attribute):
+                        continue
+                    target = expr.func.value
+                else:
+                    target = expr
                 attr = _self_attr_of_target(target)
                 if attr and ("lock" in attr.lower() or "mutex" in attr.lower()):
                     end = getattr(node, "end_lineno", node.lineno)

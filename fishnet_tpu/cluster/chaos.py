@@ -93,9 +93,17 @@ async def run_chaos(
     metrics_port: int = 0,
     drain_deadline: float = 5.0,
     verbose: int = 0,
+    fleet_port: Optional[int] = None,
 ) -> Dict:
     """Run the fleet scenario; returns the report dict (key ``ok``).
-    Raises AssertionError on a contract violation."""
+    Raises AssertionError on a contract violation.
+
+    ``fleet_port`` (0 = ephemeral) additionally runs a
+    :class:`~fishnet_tpu.telemetry.fleet.FleetAggregator` over the
+    supervisor's port-file directory for the duration — the federated
+    /metrics and /fleet routes stay scrapeable through every kill —
+    and folds its final state document into the report under
+    ``fleet_observability``."""
     from fishnet_tpu import telemetry
     from fishnet_tpu.utils.logger import Logger
 
@@ -104,6 +112,7 @@ async def run_chaos(
     report: Dict = {"procs": procs, "ok": False}
     exporter = telemetry.start_exporter(metrics_port)
     supervisor: Optional[FleetSupervisor] = None
+    aggregator = None
     try:
         lichess = fake_server_mod.FakeLichess(require_key=False)
         lichess.auto_refill = procs * 2
@@ -121,9 +130,35 @@ async def run_chaos(
                 drain_deadline=drain_deadline,
             )
             await supervisor.start()
+            if fleet_port is not None:
+                from fishnet_tpu.telemetry.fleet import (
+                    FleetAggregator,
+                    port_dir_targets,
+                )
+
+                aggregator = FleetAggregator(
+                    targets_fn=port_dir_targets(str(supervisor.workdir)),
+                    poll_interval=0.3,
+                    journal_dir=str(supervisor.workdir),
+                ).start()
+                fleet_exporter = aggregator.serve(fleet_port)
+                logger.info(
+                    f"fleet aggregator on {fleet_exporter.url}/fleet"
+                )
             t0 = time.monotonic()
             while time.monotonic() - t0 < seconds:
                 await asyncio.sleep(0.25)
+            if aggregator is not None:
+                # Final sweep + state doc BEFORE drain, while the
+                # children still answer.
+                aggregator.poll_once()
+                doc = aggregator.fleet_doc()
+                report["fleet_observability"] = {
+                    "procs": doc["procs"],
+                    "slo": doc["slo"],
+                    "stitch": doc["stitch"],
+                    "critical_path": doc["critical_path"],
+                }
             exit_codes = await supervisor.drain()
             supervisor_done = supervisor
             supervisor = None  # drained; skip the error-path kill_all
@@ -167,6 +202,8 @@ async def run_chaos(
         report["ok"] = True
         return report
     finally:
+        if aggregator is not None:
+            aggregator.close()
         if supervisor is not None:
             await supervisor.kill_all()
         exporter.close()
@@ -189,6 +226,11 @@ def main(argv=None) -> int:
         "--drain-deadline", type=float, default=5.0,
         help="drain deadline handed to every client process (seconds)",
     )
+    parser.add_argument(
+        "--fleet-port", type=int, default=None,
+        help="also run the fleet aggregator over the supervised procs "
+             "and serve /fleet on this port (0 = ephemeral)",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
     try:
@@ -199,6 +241,7 @@ def main(argv=None) -> int:
                 metrics_port=args.metrics_port,
                 drain_deadline=args.drain_deadline,
                 verbose=args.verbose,
+                fleet_port=args.fleet_port,
             )
         )
     except AssertionError as err:

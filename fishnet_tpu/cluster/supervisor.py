@@ -18,6 +18,15 @@ jittered backoff (RNG seeded from the process name), incrementing
 ``fishnet_proc_restarts_total{proc}``. :meth:`drain` is the fleet-wide
 shutdown: SIGTERM everyone, wait out the drain deadline, SIGKILL
 stragglers, stop the proxies.
+
+Observability wiring (``metrics=True``, the default): every child runs
+its metrics exporter on an ephemeral port and writes the bound port to
+``<workdir>/<name>.port`` (``--metrics-port-file``). That directory IS
+the fleet's service discovery: the
+:class:`~fishnet_tpu.telemetry.fleet.FleetAggregator` re-reads it every
+poll (:func:`~fishnet_tpu.telemetry.fleet.port_dir_targets`), so a
+restarted child that rebinds a fresh port is picked up automatically
+and a killed child goes stale instead of vanishing.
 """
 
 from __future__ import annotations
@@ -91,6 +100,7 @@ class FleetSupervisor:
         tick_seconds: float = 0.25,
         drain_deadline: float = 5.0,
         restart_backoff: float = 0.4,
+        metrics: bool = True,
     ) -> None:
         self.server_endpoint = server_endpoint
         self.specs = list(specs)
@@ -101,6 +111,7 @@ class FleetSupervisor:
         self.tick_seconds = tick_seconds
         self.drain_deadline = drain_deadline
         self.restart_backoff = restart_backoff
+        self.metrics = metrics
         self.procs: Dict[str, ProcHandle] = {}
         #: Chaos/lifecycle timeline: (seconds since start, proc, kind)
         #: with kinds spawn, kill, sigterm, exit:<rc>, restart,
@@ -151,6 +162,19 @@ class FleetSupervisor:
             "--drain-deadline", f"{int(self.drain_deadline * 1000)}ms",
             *spec.extra_args,
         ]
+        if self.metrics:
+            cmd += [
+                "--metrics-port", "0",
+                "--metrics-port-file",
+                str(self.workdir / f"{spec.name}.port"),
+                # Batch-span write-ahead: spans recorded after the
+                # aggregator's last scrape survive a SIGKILL, so the
+                # fleet stitcher can join the dead incarnation's
+                # reassigned unit cross-process. Restarts append a new
+                # incarnation header to the same file.
+                "--spans-journal",
+                str(self.workdir / f"{spec.name}.journal.jsonl"),
+            ]
         env = dict(os.environ)
         existing = env.get("PYTHONPATH")
         env["PYTHONPATH"] = (
@@ -237,6 +261,16 @@ class FleetSupervisor:
             process.send_signal(sig)
         except ProcessLookupError:
             pass  # lost the race with its own exit; the monitor sees it
+
+    def metrics_targets(self) -> Dict[str, str]:
+        """Current ``{proc_name: exporter_url}`` map from the workdir's
+        port files (empty entries for children that haven't written
+        theirs yet). The fleet aggregator takes the same directory via
+        ``targets_fn=port_dir_targets(str(sup.workdir))`` to follow
+        restarts live."""
+        from fishnet_tpu.telemetry.fleet import port_dir_targets
+
+        return port_dir_targets(str(self.workdir))()
 
     def live_count(self) -> int:
         return sum(

@@ -207,12 +207,24 @@ class Opt:
     #: off (the default; hot paths pay one flag check); 0 = an ephemeral
     #: port (logged at startup); otherwise the port /metrics binds on.
     metrics_port: Optional[int] = None
+    #: File to write the exporter's BOUND port to once it is listening
+    #: (one decimal integer). The point is ``--metrics-port 0``: a
+    #: fleet supervisor spawning many clients on one host gives each an
+    #: ephemeral port and a port file, and the fleet aggregator
+    #: discovers/follows them by re-reading the files. None = don't
+    #: write one.
+    metrics_port_file: Optional[str] = None
     #: Directory for span flight-recorder JSONL dumps
     #: (doc/observability.md). None = the ``FISHNET_SPANS_DIR`` /
     #: ``FISHNET_SPANS_FILE`` environment, falling back to a
     #: ``fishnet-spans/`` directory under the system tempdir — never
     #: the process working directory.
     spans_dir: Optional[str] = None
+    #: Batch-span journal file: every batch-trace span (the per-work-
+    #: unit lifecycle, not the kHz device path) is appended and flushed
+    #: line-by-line, so a SIGKILLed process's final spans survive for
+    #: the fleet stitcher. None = journaling off.
+    spans_journal: Optional[str] = None
     #: Deterministic fault plan (doc/resilience.md grammar). None =
     #: fault injection off (the default; sites pay one flag check).
     #: ``FISHNET_FAULT_PLAN`` in the environment is the fallback for
@@ -341,10 +353,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "/json snapshot) on this port and arm the SIGUSR2 "
                         "span-dump. 0 picks an ephemeral port. Default: "
                         "telemetry off.")
+    p.add_argument("--metrics-port-file", default=None,
+                   help="Write the exporter's bound port to this file once "
+                        "listening (pairs with --metrics-port 0; the fleet "
+                        "aggregator's --port-dir discovery reads these).")
     p.add_argument("--spans-dir", default=None,
                    help="Directory for span flight-recorder JSONL dumps "
                         "(fishnet-spans-<pid>.jsonl). Default: "
                         "$FISHNET_SPANS_DIR, else <tempdir>/fishnet-spans.")
+    p.add_argument("--spans-journal", default=None,
+                   help="Append every batch-trace span to this JSONL file "
+                        "(flushed per line) so spans recorded after the "
+                        "last scrape survive a SIGKILL for the fleet "
+                        "stitcher. Default: off.")
     p.add_argument("--fault-plan", default=None,
                    help="Deterministic fault plan (doc/resilience.md "
                         "grammar), e.g. 'seed=7;net.acquire:nth=2:error'. "
@@ -421,8 +442,12 @@ def _opt_from_namespace(ns: argparse.Namespace) -> Opt:
         opt.mesh = parse_mesh(ns.mesh)
     if ns.metrics_port is not None:
         opt.metrics_port = _parse_port(str(ns.metrics_port))
+    if ns.metrics_port_file is not None:
+        opt.metrics_port_file = ns.metrics_port_file
     if ns.spans_dir is not None:
         opt.spans_dir = ns.spans_dir
+    if ns.spans_journal is not None:
+        opt.spans_journal = ns.spans_journal
     if ns.fault_plan is not None:
         opt.fault_plan = _parse_fault_plan(ns.fault_plan)
     if ns.batch_deadline is not None:
@@ -488,7 +513,9 @@ _INI_FIELDS = (
     ("SearchConcurrency", "search_concurrency",
      lambda v: _positive_int(v, "SearchConcurrency")),
     ("MetricsPort", "metrics_port", lambda v: _parse_port(v)),
+    ("MetricsPortFile", "metrics_port_file", str),
     ("SpansDir", "spans_dir", str),
+    ("SpansJournal", "spans_journal", str),
     ("FaultPlan", "fault_plan", lambda v: _parse_fault_plan(v)),
     ("BatchDeadline", "batch_deadline", parse_duration),
     ("Tenants", "tenants", lambda v: _positive_int(v, "Tenants")),

@@ -12,6 +12,17 @@ Three parts (see doc/observability.md for the exported-name contract):
 * :mod:`fishnet_tpu.telemetry.exporter` — ``/metrics`` (Prometheus
   text) + ``/json`` on a stdlib ``http.server`` thread.
 
+Fleet layer (one aggregator over many processes' exporters):
+
+* :mod:`fishnet_tpu.telemetry.fleet` — the FleetAggregator: federated
+  scraping with ``proc`` relabeling and staleness marking, plus the
+  live ops console (``python -m fishnet_tpu.telemetry.fleet``);
+* :mod:`fishnet_tpu.telemetry.stitch` — cross-process trace stitching
+  (deterministic batch trace ids join spans recorded by different
+  processes) and the fleet critical-path report;
+* :mod:`fishnet_tpu.telemetry.slo` — declarative SLOs evaluated as
+  multi-window error-budget burn rates over the federated series.
+
 Hot-path discipline: telemetry is **disabled by default**. Span
 instrumentation in the serving path is gated on :func:`enabled` (one
 module-attribute read when off); metric *collection* is pull-only, so a
@@ -27,6 +38,7 @@ from typing import Optional
 
 from fishnet_tpu.telemetry.registry import (  # noqa: F401 - public API
     REGISTRY,
+    SUMMARY_QUANTILES,
     Counter,
     Gauge,
     Histogram,
@@ -35,6 +47,9 @@ from fishnet_tpu.telemetry.registry import (  # noqa: F401 - public API
     Sample,
     counter_family,
     gauge_family,
+    histogram_quantiles,
+    percentile,
+    quantile_from_buckets,
 )
 from fishnet_tpu.telemetry.spans import (  # noqa: F401 - public API
     EVENT_STAGES,

@@ -48,19 +48,26 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
-#: Component names in the order bench.py reports them.
+#: Component names in the order bench.py reports them. ``reassignment``
+#: is fleet-only: the dead time between a process dying with a unit in
+#: flight and another process re-acquiring it (telemetry/stitch.py
+#: synthesizes the span); single-process traces never contain it.
 COMPONENTS = (
     "queue_wait", "pack", "transport", "device_compute", "decode_wait",
-    "submit", "other",
+    "submit", "reassignment", "other",
 )
 
 #: Sweep priority per component (higher wins where intervals overlap).
+#: ``reassignment`` outranks queue_wait (the unit is not queued anywhere
+#: during the gap — it is lost until the server's sweep re-hands it)
+#: but yields to every live-work component.
 _PRIORITY = {
     "pack": 60,
     "submit": 50,
     "transport": 40,
     "device_compute": 30,
     "decode_wait": 20,
+    "reassignment": 15,
     "queue_wait": 10,
 }
 
@@ -76,6 +83,7 @@ _STAGE_COMPONENT = {
     "queue_wait": "queue_wait",
     "acquire": "pack",
     "schedule": "pack",
+    "reassignment": "reassignment",
 }
 
 
@@ -252,7 +260,7 @@ def report(
         "queue_wait": "queue_wait_ms", "pack": "pack_ms",
         "transport": "transport_ms", "device_compute": "compute_ms",
         "decode_wait": "decode_wait_ms", "submit": "submit_ms",
-        "other": "other_ms",
+        "reassignment": "reassignment_ms", "other": "other_ms",
     }
     out = {v: 0.0 for v in keys.values()}
     out.update({"wall_ms": 0.0, "coverage": 0.0, "traces": n})

@@ -35,10 +35,48 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
 from fishnet_tpu.telemetry.registry import REGISTRY, MetricsRegistry
+
+#: Registered once per process (first exporter construction): the
+#: aggregator — or any Prometheus — computes uptime and detects
+#: restarts from this instead of scraping logs.
+_PROC_START_TIME = time.time()
+
+
+def register_process_info(registry: Optional[MetricsRegistry] = None) -> None:
+    """Register ``fishnet_build_info{version,abi,jax}`` (value always
+    1; identity rides the labels, the node_exporter idiom) and
+    ``fishnet_proc_start_time_seconds`` on ``registry``. Idempotent —
+    the registry returns the existing instruments on re-registration —
+    and called by every exporter at construction so the families are
+    present on every /metrics surface."""
+    registry = registry if registry is not None else REGISTRY
+    from fishnet_tpu.chess.core import ABI_VERSION
+    from fishnet_tpu.version import __version__
+
+    try:
+        from importlib.metadata import version as _dist_version
+
+        jax_version = _dist_version("jax")
+    except Exception:  # noqa: BLE001 - jax genuinely absent or unversioned
+        jax_version = "none"
+    info = registry.gauge(
+        "fishnet_build_info",
+        "Build identity as labels (value is always 1): client version, "
+        "native-core ABI, jax version.",
+        labelnames=("version", "abi", "jax"),
+    )
+    info.set(1.0, version=__version__, abi=str(ABI_VERSION), jax=jax_version)
+    start = registry.gauge(
+        "fishnet_proc_start_time_seconds",
+        "Unix time this process's telemetry started; uptime = now - "
+        "this, and a changed value at the same target means a restart.",
+    )
+    start.set(_PROC_START_TIME)
 
 #: Health providers: name -> zero-arg callable returning a dict of
 #: serving state (or None to self-unregister, the collector idiom).
@@ -97,16 +135,32 @@ def health_snapshot() -> Tuple[int, Optional[dict]]:
 
 class MetricsExporter:
     """Owns the HTTP server + its thread. ``port`` is the bound port
-    (useful with port 0 = ephemeral)."""
+    (useful with port 0 = ephemeral). ``extra_routes`` maps a path to a
+    zero-arg callable returning ``(status, content_type, body_bytes)``
+    — the fleet aggregator mounts ``/fleet*`` through this without
+    subclassing the handler."""
 
     def __init__(
         self,
         port: int = 0,
         host: str = "127.0.0.1",
         registry: Optional[MetricsRegistry] = None,
+        extra_routes: Optional[
+            Dict[str, Callable[[], Tuple[int, str, bytes]]]
+        ] = None,
     ) -> None:
         registry = registry if registry is not None else REGISTRY
-        handler = _make_handler(registry)
+        register_process_info(registry)
+        self._registry = registry
+        # Scrape guard (the scrape-vs-shutdown race, doc/observability
+        # .md): handler threads hold this lock across a scrape; close()
+        # takes it to flip _closed, so after close() returns no
+        # collector callback from THIS exporter can still be running
+        # against a service being torn down, and any later-arriving
+        # request is refused with a 503 instead of scraping.
+        self._scrape_guard = threading.Lock()
+        self._closed = False
+        handler = _make_handler(registry, self, extra_routes or {})
         self._server = ThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True
         self.host = host
@@ -123,12 +177,23 @@ class MetricsExporter:
         return f"http://{self.host}:{self.port}"
 
     def close(self) -> None:
+        with self._scrape_guard:  # waits out any in-flight scrape
+            self._closed = True
         self._server.shutdown()
         self._server.server_close()
         self._thread.join(timeout=5.0)
+        # Symmetry with the PR 3 unregister path: also drain any scrape
+        # running through the registry from another exporter/thread, so
+        # a caller sequencing `exporter.close(); service.close()` never
+        # has a collector mid-run against the dying service.
+        self._registry.scrape_barrier()
 
 
-def _make_handler(registry: MetricsRegistry):
+def _make_handler(
+    registry: MetricsRegistry,
+    exporter: "MetricsExporter",
+    extra_routes: Dict[str, Callable[[], Tuple[int, str, bytes]]],
+):
     class _Handler(BaseHTTPRequestHandler):
         # Scrapers poll; access-logging them to stderr is pure noise.
         def log_message(self, fmt, *args):  # noqa: D401
@@ -141,24 +206,49 @@ def _make_handler(registry: MetricsRegistry):
             self.end_headers()
             self.wfile.write(body)
 
+        def _scrape(self, render: Callable[[], Tuple[str, bytes]]) -> None:
+            """Run a collector-touching render under the exporter's
+            scrape guard; refuse with 503 once close() has begun."""
+            with exporter._scrape_guard:
+                if exporter._closed:
+                    self._send(503, "text/plain", b"closing\n")
+                    return
+                content_type, body = render()
+            self._send(200, content_type, body)
+
         def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
             path = self.path.split("?", 1)[0]
             try:
                 if path == "/metrics":
-                    body = registry.render_prometheus().encode()
-                    self._send(
-                        200,
+                    self._scrape(lambda: (
                         "text/plain; version=0.0.4; charset=utf-8",
-                        body,
-                    )
+                        registry.render_prometheus().encode(),
+                    ))
                 elif path == "/json":
-                    body = json.dumps(registry.render_json()).encode()
-                    self._send(200, "application/json", body)
+                    self._scrape(lambda: (
+                        "application/json",
+                        json.dumps(registry.render_json()).encode(),
+                    ))
                 elif path == "/spans":
+                    import os as _os
+
                     from fishnet_tpu.telemetry.spans import RECORDER
 
-                    body = json.dumps({"spans": RECORDER.spans()}).encode()
+                    # pid + the monotonic->epoch anchor ride along so
+                    # the fleet aggregator can key span archives per
+                    # process incarnation and rebase every process's
+                    # spans onto one wall clock before stitching.
+                    body = json.dumps({
+                        "pid": _os.getpid(),
+                        "monotonic_to_epoch": round(
+                            RECORDER.epoch_offset, 6
+                        ),
+                        "spans": RECORDER.spans(),
+                    }).encode()
                     self._send(200, "application/json", body)
+                elif path in extra_routes:
+                    status, content_type, body = extra_routes[path]()
+                    self._send(status, content_type, body)
                 elif path == "/trace":
                     from fishnet_tpu.telemetry.spans import RECORDER
                     from fishnet_tpu.telemetry.trace_export import (

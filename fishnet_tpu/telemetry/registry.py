@@ -303,6 +303,89 @@ def _format_bound(b: float) -> str:
     return repr(int(b)) if float(b).is_integer() else repr(b)
 
 
+#: The quantiles every histogram summary reports. A stable contract:
+#: bench.py, the fleet console, and the SLO engine all read these keys
+#: instead of re-deriving percentiles their own way.
+SUMMARY_QUANTILES: Tuple[float, ...] = (0.5, 0.9, 0.99)
+
+
+def percentile(values: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile over RAW samples (q in [0, 100]); None
+    on no samples. The one definition bench.py and the fleet tooling
+    share — keep percentile math in one place."""
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, round(q / 100.0 * (len(vs) - 1))))
+    return vs[idx]
+
+
+def quantile_from_buckets(
+    bounds: Sequence[float],
+    cumulative: Sequence[float],
+    total: float,
+    q: float,
+) -> Optional[float]:
+    """Estimate the ``q`` quantile (q in [0, 1]) from cumulative
+    histogram bucket counts (Prometheus ``histogram_quantile``
+    semantics: linear interpolation within the bucket; the +Inf bucket
+    clamps to the largest finite bound). None when the histogram is
+    empty."""
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound = 0.0
+    prev_count = 0.0
+    for bound, count in zip(bounds, cumulative):
+        if count >= rank:
+            in_bucket = count - prev_count
+            if in_bucket <= 0:
+                return float(bound)
+            frac = (rank - prev_count) / in_bucket
+            return float(prev_bound + (bound - prev_bound) * frac)
+        prev_bound, prev_count = float(bound), float(count)
+    return float(bounds[-1]) if bounds else None
+
+
+def histogram_quantiles(
+    fam: "MetricFamily", quantiles: Sequence[float] = SUMMARY_QUANTILES
+) -> List[dict]:
+    """Per-label-set quantile summaries for a histogram FAMILY (the
+    flat ``_bucket``/``_sum``/``_count`` exposition shape — works on a
+    live instrument's collect() and on families federated from another
+    process alike). Returns one dict per label set:
+    ``{"labels": {...}, "count": n, "sum": s, "p50": ..., ...}``."""
+    if fam.type != "histogram":
+        return []
+    groups: Dict[Tuple[Tuple[str, str], ...], dict] = {}
+    for s in fam.samples:
+        base = {k: v for k, v in s.labels.items() if k != "le"}
+        key = tuple(sorted(base.items()))
+        g = groups.setdefault(
+            key, {"labels": base, "buckets": [], "sum": 0.0, "count": 0.0}
+        )
+        if s.name.endswith("_bucket"):
+            le = s.labels.get("le", "+Inf")
+            if le not in ("+Inf", "inf"):
+                g["buckets"].append((float(le), float(s.value)))
+        elif s.name.endswith("_sum"):
+            g["sum"] = float(s.value)
+        elif s.name.endswith("_count"):
+            g["count"] = float(s.value)
+    out = []
+    for g in groups.values():
+        g["buckets"].sort(key=lambda bc: bc[0])
+        bounds = [b for b, _ in g["buckets"]]
+        cum = [c for _, c in g["buckets"]]
+        row = {"labels": g["labels"], "count": g["count"], "sum": g["sum"]}
+        for q in quantiles:
+            row[f"p{int(q * 100)}"] = quantile_from_buckets(
+                bounds, cum, g["count"], q
+            )
+        out.append(row)
+    return out
+
+
 class MetricsRegistry:
     """Instrument + collector registry. Scrapes serialize on one lock so
     ``unregister_collector`` can guarantee its callback is not mid-run
@@ -370,6 +453,14 @@ class MetricsRegistry:
             with self._lock:
                 self._collectors.pop(token, None)
 
+    def scrape_barrier(self) -> None:
+        """Block until no scrape is mid-flight. The close-path symmetry
+        of :meth:`unregister_collector`: an exporter shutting down calls
+        this so no collector callback can still be running against a
+        service being torn down when ``close()`` returns."""
+        with self._scrape_lock:
+            pass
+
     # -- scraping ---------------------------------------------------------
 
     def collect(self) -> List[MetricFamily]:
@@ -414,10 +505,14 @@ class MetricsRegistry:
         return "\n".join(out) + "\n"
 
     def render_json(self) -> dict:
-        """JSON snapshot of the same families (the debug endpoint)."""
+        """JSON snapshot of the same families (the debug endpoint).
+        Histogram families carry a ``quantiles`` summary (p50/p90/p99
+        per label set, interpolated from the buckets) so consumers —
+        bench.py, the fleet console, any dashboard — read percentiles
+        from one derivation instead of re-deriving from raw buckets."""
         metrics = {}
         for fam in self.collect():
-            metrics[fam.name] = {
+            entry = {
                 "type": fam.type,
                 "help": fam.help,
                 "samples": [
@@ -425,6 +520,9 @@ class MetricsRegistry:
                     for s in fam.samples
                 ],
             }
+            if fam.type == "histogram":
+                entry["quantiles"] = histogram_quantiles(fam)
+            metrics[fam.name] = entry
         return {"time": time.time(), "metrics": metrics}
 
 

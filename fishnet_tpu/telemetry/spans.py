@@ -67,6 +67,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from typing import Dict, List, Optional
@@ -88,6 +89,16 @@ EVENT_STAGES = (
 FORMAT = "fishnet-spans/2"
 
 DEFAULT_CAPACITY = 4096  # spans kept per thread
+
+#: Journal header format (one header per process incarnation, then one
+#: span object per line — only batch-trace spans are journaled).
+JOURNAL_FORMAT = "fishnet-spans-journal/1"
+
+#: Batch trace ids (blake2b digest, tracing.trace_id_for_batch): the
+#: globally-joinable traces worth journaling. Step-trace ids
+#: (``<tid>.<n>``) never match — they are process-local and orders of
+#: magnitude hotter, so they stay ring-only.
+_GLOBAL_TRACE = re.compile(r"^[0-9a-f]{16}$")
 
 
 class _Ring:
@@ -126,9 +137,18 @@ class SpanRecorder:
         self._rings: List[_Ring] = []
         self._lock = threading.Lock()  # ring creation + dump serialization
         self._seq = 0
+        self._journal = None
+        self._journal_lock = threading.Lock()
         # Monotonic->epoch anchor so dump consumers can place spans on a
         # wall clock.
         self._epoch_offset = time.time() - time.monotonic()
+
+    @property
+    def epoch_offset(self) -> float:
+        """Monotonic->epoch anchor (``t + epoch_offset`` is wall time).
+        The fleet aggregator rebases every process's spans onto this
+        common clock before stitching cross-process traces."""
+        return self._epoch_offset
 
     # -- hot path ---------------------------------------------------------
 
@@ -159,7 +179,80 @@ class SpanRecorder:
                 fields["parent_id"] = trace.parent_id
         if links:
             fields["links"] = [list(lk) for lk in links]
-        ring.append((stage, started, time.monotonic() - started, fields))
+        dur = time.monotonic() - started
+        ring.append((stage, started, dur, fields))
+        if (
+            self._journal is not None
+            and trace is not None
+            and _GLOBAL_TRACE.match(trace.trace_id)
+        ):
+            self._journal_write(stage, started, dur, ring.thread, fields)
+
+    # -- journaling -------------------------------------------------------
+
+    def journal_to(self, path: str) -> None:
+        """Start (or restart) the batch-span journal: every subsequent
+        batch-trace span — acquire/schedule/queue_wait/submit, the
+        low-rate per-work-unit lifecycle — is appended to ``path`` and
+        flushed line-by-line, so a SIGKILLed process's last spans
+        survive for the fleet stitcher even when they were recorded
+        after the aggregator's final scrape. Step traces (the kHz
+        device-dispatch path) are never journaled. Appends one header
+        line identifying this incarnation (pid + clock anchor); a
+        restarted process appends a fresh header to the same file."""
+        header = {
+            "format": JOURNAL_FORMAT,
+            "pid": os.getpid(),
+            "started_at": time.time(),
+            "monotonic_to_epoch": round(self._epoch_offset, 6),
+        }
+        with self._journal_lock:
+            self._journal_stop_locked()
+            try:
+                parent = os.path.dirname(path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                fp = open(path, "a")
+                fp.write(json.dumps(header) + "\n")
+                fp.flush()
+            except OSError:
+                return
+            self._journal = fp
+
+    def journal_close(self) -> None:
+        with self._journal_lock:
+            self._journal_stop_locked()
+
+    def _journal_stop_locked(self) -> None:
+        if self._journal is not None:
+            try:
+                self._journal.close()
+            except OSError:
+                pass
+            self._journal = None
+
+    def _journal_write(
+        self, stage: str, started: float, dur: float, thread: str, fields: dict
+    ) -> None:
+        # EXACTLY the spans() record shape (same rounding), so the
+        # aggregator's per-incarnation dedup collapses a span seen via
+        # both the /spans scrape and the journal into one.
+        rec = {
+            "stage": stage,
+            "t": round(started, 6),
+            "dur_ms": round(dur * 1e3, 3),
+            "thread": thread,
+        }
+        if fields:
+            rec.update(fields)
+        with self._journal_lock:
+            if self._journal is None:
+                return
+            try:
+                self._journal.write(json.dumps(rec) + "\n")
+                self._journal.flush()
+            except (OSError, ValueError):
+                self._journal = None
 
     # -- dumping ----------------------------------------------------------
 

@@ -39,18 +39,39 @@ def _us(t: float) -> float:
 
 
 def chrome_trace(spans: List[dict], pid: int = 1) -> dict:
-    """Build a Chrome Trace Event Format object from flat spans."""
-    events: List[dict] = []
-    tids: Dict[str, int] = {}
+    """Build a Chrome Trace Event Format object from flat spans.
 
-    def tid_of(thread: Optional[str]) -> int:
-        name = thread or "unknown"
-        tid = tids.get(name)
-        if tid is None:
-            tid = tids[name] = len(tids) + 1
+    A span carrying a ``proc`` field (the fleet aggregator's stitched
+    output, telemetry/stitch.py) lands in that process's own track
+    group: one Chrome ``pid`` per distinct ``proc`` with a
+    ``process_name`` metadata event, so a fleet export renders one
+    track group per client process. Spans without ``proc`` all share
+    the default ``pid`` — single-process exports are unchanged."""
+    events: List[dict] = []
+    tids: Dict[tuple, int] = {}
+    pids: Dict[str, int] = {}
+
+    def pid_of(proc: Optional[str]) -> int:
+        if proc is None:
+            return pid
+        p = pids.get(proc)
+        if p is None:
+            p = pids[proc] = pid + 1 + len(pids)
             events.append({
-                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
-                "args": {"name": name},
+                "ph": "M", "name": "process_name", "pid": p, "tid": 0,
+                "args": {"name": proc},
+            })
+        return p
+
+    def tid_of(proc: Optional[str], thread: Optional[str]) -> tuple:
+        name = thread or "unknown"
+        key = (proc, name)
+        tid = tids.get(key)
+        if tid is None:
+            tid = tids[key] = len(tids) + 1
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid_of(proc),
+                "tid": tid, "args": {"name": name},
             })
         return tid
 
@@ -60,20 +81,26 @@ def chrome_trace(spans: List[dict], pid: int = 1) -> dict:
         if sid is not None:
             by_id[sid] = s
 
+    def track_of(s: dict):
+        proc = s.get("proc")
+        return pid_of(proc), tid_of(proc, s.get("thread"))
+
     flow_n = 0
     for s in spans:
-        tid = tid_of(s.get("thread"))
+        s_pid, tid = track_of(s)
         args = {
             k: v for k, v in s.items()
             if k not in ("stage", "t", "dur_ms", "thread")
         }
         events.append({
-            "ph": "X", "name": s["stage"], "cat": "fishnet", "pid": pid,
+            "ph": "X", "name": s["stage"], "cat": "fishnet", "pid": s_pid,
             "tid": tid, "ts": _us(s["t"]),
             "dur": round(s.get("dur_ms", 0.0) * 1e3, 1), "args": args,
         })
-        # Flow arrows: one per cross-thread causal edge (parent link or
-        # fan-in link) whose source span is present in the dump.
+        # Flow arrows: one per cross-track causal edge (parent link or
+        # fan-in link) whose source span is present in the dump — the
+        # cross-PROCESS edges of a stitched fleet trace render exactly
+        # like cross-thread handoffs, arrows across track groups.
         sources = []
         parent = by_id.get(s.get("parent_id"))
         if parent is not None:
@@ -83,19 +110,21 @@ def chrome_trace(spans: List[dict], pid: int = 1) -> dict:
             if src is not None:
                 sources.append(src)
         for src in sources:
-            if src.get("thread") == s.get("thread"):
+            if src.get("thread") == s.get("thread") and (
+                src.get("proc") == s.get("proc")
+            ):
                 continue
             flow_n += 1
             fid = f"flow{flow_n}"
-            src_tid = tid_of(src.get("thread"))
+            src_pid, src_tid = track_of(src)
             events.append({
                 "ph": "s", "id": fid, "name": "handoff", "cat": _FLOW_CAT,
-                "pid": pid, "tid": src_tid,
+                "pid": src_pid, "tid": src_tid,
                 "ts": _us(src["t"] + src.get("dur_ms", 0.0) / 1e3),
             })
             events.append({
                 "ph": "f", "bp": "e", "id": fid, "name": "handoff",
-                "cat": _FLOW_CAT, "pid": pid, "tid": tid, "ts": _us(s["t"]),
+                "cat": _FLOW_CAT, "pid": s_pid, "tid": tid, "ts": _us(s["t"]),
             })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
@@ -121,9 +150,9 @@ def validate_chrome_trace(obj: dict) -> None:
             if not isinstance(ev.get(key), int):
                 raise ValueError(f"event {i}: {key} must be an int")
         if ph == "M":
-            if ev.get("name") != "thread_name" or "name" not in ev.get(
-                "args", {}
-            ):
+            if ev.get("name") not in (
+                "thread_name", "process_name"
+            ) or "name" not in ev.get("args", {}):
                 raise ValueError(f"event {i}: malformed metadata event")
             continue
         if not isinstance(ev.get("ts"), (int, float)):

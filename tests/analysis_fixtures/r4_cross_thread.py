@@ -164,3 +164,24 @@ class LeakyStripedCache:
     async def probe(self, key):
         with self._lock:
             self._entries += 1
+
+
+class JournalReader:
+    """`with open(...)` in a thread-bearing class: the context manager
+    is a plain-Name call, not a `self.<attr>` lock — _lock_spans must
+    skip it, not crash.  Guarded mutations keep the class clean."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._offsets = {}
+        self._poller = threading.Thread(target=self._poll_loop)
+
+    def _poll_loop(self):
+        with open("/dev/null", "rb") as fh:
+            data = fh.read()
+        with self._lock:
+            self._offsets["x"] = len(data)
+
+    async def snapshot(self):
+        with self._lock:
+            return dict(self._offsets)

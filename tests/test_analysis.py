@@ -288,3 +288,14 @@ def test_cli_exit_codes():
     assert rules.returncode == 0
     for rid in ("R1", "R2", "R3", "R4", "R5"):
         assert rid in rules.stdout
+
+
+def test_r4_plain_call_context_manager_is_skipped():
+    """`with open(...)` (a Name-func call) inside a thread-bearing
+    class must not crash _lock_spans, and the guarded JournalReader
+    stays clean."""
+    findings = check_paths(
+        [FIXTURES / "r4_cross_thread.py"], [CrossThreadStateRule()]
+    )
+    assert not any("JournalReader" in f.message for f in findings)
+    assert not any("_offsets" in f.message for f in findings)

@@ -211,6 +211,36 @@ def test_critical_path_detects_orphans():
     assert len(orphans) == 1 and orphans[0]["stage"] == "device_step"
 
 
+def test_fleet_joiner_adopts_orphans_from_killed_process():
+    """The fleet stitcher (telemetry/stitch.py) feeds the SAME orphan
+    detector: after joining a killed-and-reassigned unit whose dead
+    actor lost a parent span to a missed scrape, the stitched output
+    must be orphan-free — lost parents are adopted under the trace
+    root and counted, never dropped."""
+    from fishnet_tpu.telemetry.stitch import stitch
+    from fishnet_tpu.telemetry.tracing import trace_id_for_batch
+
+    tid = trace_id_for_batch("orphan-unit")
+    # Dead actor: the batch root was never scraped (SIGKILL between
+    # scrapes), leaving its child dangling.
+    dead = [
+        _mk("queue_wait", 1.0, 100.0, tid, "1.2", "lost-parent"),
+    ]
+    survivor = [
+        _mk("acquire", 2.0, 50.0, tid, tid),
+        _mk("submit", 2.2, 30.0, tid, "2.1", tid),
+    ]
+    report = stitch([
+        {"proc": "P0", "actor": "P0@1", "spans": dead, "epoch_offset": 0.0},
+        {"proc": "P1", "actor": "P1@2", "spans": survivor,
+         "epoch_offset": 0.0},
+    ])
+    assert report["orphans_adopted"] >= 1
+    assert report["reassignments"] == 1
+    for trace in cp.group_traces(report["spans"]).values():
+        assert cp.orphan_spans(trace) == []
+
+
 def test_critical_path_attribution_sums_to_wall():
     attr = cp.attribute_trace(_synthetic_step_trace(), fixed_transport_ms=5.0)
     wall = attr["wall_ms"]
