@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-obs-smoke
+.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-obs-smoke mcts-smoke
 
 # Project-invariant static checker (R1-R4); exit 0 = clean tree.
 analysis:
@@ -76,6 +76,18 @@ multichip-smoke:
 cache-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_eval_cache.py -q \
 		-k "parity and not mesh"
+
+# Shared-plane batched MCTS contract (doc/search.md "Two search
+# families, one dispatch plane", ≤45 s subset of
+# tests/test_mcts_plane.py): plane-vs-legacy bit parity on every
+# forced degradation rung with the AZ eval cache live, the
+# FISHNET_NO_SHARED_AZ_PLANE escape hatch, pre-wire AZ eval reuse
+# across a pool respawn, and the preallocated step-buffer guard. The
+# full file — tree semantics, self-play parity, telemetry families,
+# bench schema — runs in tier-1.
+mcts-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_mcts_plane.py -q \
+		-k "parity_all_rungs or prewire or preallocated"
 
 # Fleet crash-tolerance contract (doc/resilience.md "Fleet chaos",
 # ≤60 s): real client processes behind chaos proxies — a SIGKILL, a

@@ -721,6 +721,25 @@ SUMMARY_SCHEMA = {
         "eval_cache_hit_rate", "position_dedup_per_dispatch",
         "prewire_hits", "skipped_dispatches", "seconds",
     ),
+    # --mcts mode (keyed by mode == "mcts"): shared-plane batched MCTS
+    # (ISSUE 14) — AZ leaf traffic on the coalesced dispatch plane.
+    # Headline: sustained warm visits/s over replays of a fixed
+    # workload, vs the legacy feature-off baseline, with a fresh-pool
+    # respawn phase pinning pre-wire AZ eval reuse and a forced-rung
+    # parity sweep (doc/search.md "Two search families, one dispatch
+    # plane").
+    "mcts": (
+        "metric", "value", "unit", "mode", "trees", "visits",
+        "warm_rounds", "batch_capacity", "speedup_vs_baseline",
+        "reference_baseline_visits_per_s", "speedup_vs_reference",
+        "baseline", "cold", "warm", "respawn", "parity", "ledger",
+        "cache",
+    ),
+    "mcts.phase": (
+        "visits", "seconds", "visits_per_s", "evals", "batch_fill_ema",
+        "dispatch_fill", "collision_rate", "memo_hits", "reuse_hits",
+        "prewire_hits", "rows_dispatched", "eval_cache_hit_rate",
+    ),
     "overload.latency": (
         "move_p50_ms", "move_p99_ms", "move_n", "move_p99_budget_ms",
         "move_within_budget", "analysis_first_p50_ms",
@@ -784,6 +803,17 @@ def validate_summary(summary: dict) -> None:
                 f"{ph}.{k}"
                 for k in SUMMARY_SCHEMA["cache_replay.phase"]
                 if k not in sub
+            ]
+        if missing:
+            raise ValueError(f"bench summary missing keys: {missing}")
+        return
+    if summary.get("mode") == "mcts":
+        missing = [k for k in SUMMARY_SCHEMA["mcts"] if k not in summary]
+        for ph in ("baseline", "cold", "warm", "respawn"):
+            sub = summary.get(ph, {})
+            missing += [
+                f"{ph}.{k}"
+                for k in SUMMARY_SCHEMA["mcts.phase"] if k not in sub
             ]
         if missing:
             raise ValueError(f"bench summary missing keys: {missing}")
@@ -1750,6 +1780,267 @@ def run_cache_replay_bench(nodes: int = CACHE_REPLAY_NODES) -> dict:
     }
 
 
+#: Fixed MCTS bench workload: 16 opening lines from the start position,
+#: cycled over the submitted trees. Lines (not scattered FENs) exercise
+#: transposition sharing (expansion memo / AzEvalCache) and the
+#: cross-move subtree-reuse probes the same way self-play does.
+MCTS_OPENINGS = [
+    [], ["e2e4"], ["d2d4"], ["c2c4"], ["g1f3"],
+    ["e2e4", "c7c5"], ["e2e4", "e7e5"], ["d2d4", "d7d5"],
+    ["d2d4", "g8f6"], ["c2c4", "e7e5"], ["g1f3", "d7d5"],
+    ["e2e4", "e7e6"], ["e2e4", "c7c6"], ["d2d4", "f7f5"],
+    ["c2c4", "c7c5"], ["e2e4", "g7g6"],
+]
+MCTS_TREES = 64
+MCTS_VISITS = 300
+MCTS_WARM_ROUNDS = 6
+#: The pre-ISSUE-14 single-plane measurement the acceptance gate is
+#: phrased against (ISSUE.md: "the 437 visits/s baseline").
+MCTS_REFERENCE_VISITS_PER_S = 437.0
+
+
+def run_mcts_bench(
+    trees: int = MCTS_TREES,
+    visits: int = MCTS_VISITS,
+    warm_rounds: int = MCTS_WARM_ROUNDS,
+) -> dict:
+    """Shared-plane batched MCTS benchmark (ISSUE 14): AZ leaf traffic
+    on the coalesced dispatch plane, under the same phase discipline as
+    the NNUE cache-replay bench —
+
+    * ``baseline`` — the legacy private-jit path with every ISSUE-14
+      feature off (no plane, no eval cache, no expansion memo, no
+      subtree reuse, fixed leaf width): the pre-PR pool.
+    * ``cold``     — shared plane, fresh pool, empty caches: one round
+      of the fixed workload, populating the expansion memo and the
+      process-wide AzEvalCache.
+    * ``warm``     — the HEADLINE: ``warm_rounds`` replays of the same
+      workload on the same pool, sustained aggregate visits/s. Warm
+      visits resolve from the expansion memo (no dispatch at all) or
+      pre-wire from the AzEvalCache; the residual tree-growth trickle
+      rides right-sized ladder buckets.
+    * ``respawn``  — a NEW pool (memo cold, the supervisor-respawn
+      shape) against the surviving process cache: pins that AZ evals
+      hit eval reuse PRE-WIRE (nonzero prewire_hits, rows near zero).
+
+    ``parity`` runs a small fixed workload through the legacy path and
+    through the plane at each forced degradation rung (fused / solo /
+    chunk) and compares full search results — best move, visit counts,
+    values, root visit distributions, PVs — bit-for-bit. The
+    exactly-once ledger audits every phase."""
+    import jax
+
+    from fishnet_tpu.models.az import init_az_params
+    from fishnet_tpu.protocol.types import STARTPOS
+    from fishnet_tpu.resilience import accounting
+    from fishnet_tpu.search import eval_cache
+    from fishnet_tpu.search.mcts import MctsConfig, MctsPool
+
+    # Capacity 64 is sized to steady-state leaf demand: with the
+    # expansion memo hot most visits complete inside collect, so ~56
+    # leaves/step reach the plane — a 256 cap would report a near-empty
+    # tree-side fill for the identical dispatch behavior (the bucket
+    # ladder right-sizes device batches either way), and the warm phase
+    # dispatches so few rows that the smaller ceiling costs no
+    # throughput where it matters.
+    cfg = MctsConfig(batch_capacity=64, expansion_memo=1 << 18)
+    params = jax.device_put(init_az_params(jax.random.PRNGKey(0), cfg.az))
+
+    def run_round(pool, ledger, tag, n_trees, n_visits):
+        t0 = time.perf_counter()
+        sids = []
+        for i in range(n_trees):
+            bid = f"mcts-{tag}-{i}"
+            ledger.record_acquired(bid)
+            sids.append((bid, pool.submit(
+                STARTPOS, list(MCTS_OPENINGS[i % len(MCTS_OPENINGS)]),
+                n_visits,
+            )))
+        while pool.active() > 0:
+            pool.step()
+        total = 0
+        results = []
+        for bid, sid in sids:
+            r = pool.harvest(sid)
+            ledger.record_submitted(bid)
+            total += r.visits
+            results.append((
+                r.best_move, r.visits, r.value,
+                tuple(r.root_visits), tuple(r.pv),
+            ))
+        return total, time.perf_counter() - t0, results
+
+    def snap(pool):
+        c = pool.counters()
+        d = c.pop("dispatch", None) or {}
+        flat = {k: v for k, v in c.items() if isinstance(v, (int, float))}
+        for k in ("prewire_hits", "rows_dispatched", "slots_dispatched",
+                  "skipped_dispatches", "dispatches"):
+            flat["d_" + k] = d.get(k, 0)
+        return flat
+
+    def phase(tv, dt, before, after):
+        d = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+        evals = max(1, d.get("evals", 0))
+        return {
+            "visits": tv,
+            "seconds": round(dt, 2),
+            "visits_per_s": round(tv / max(dt, 1e-9)),
+            "evals": d.get("evals", 0),
+            # Pool-side fill (EMA of leaves per step over capacity) and
+            # device-side fill (rows over dispatched bucket slots).
+            "batch_fill_ema": round(after.get("fill_ema", 0.0), 4),
+            "dispatch_fill": round(
+                d.get("d_rows_dispatched", 0)
+                / max(1, d.get("d_slots_dispatched", 0)), 4,
+            ),
+            "collision_rate": round(
+                d.get("collisions", 0)
+                / max(1, d.get("visits", 0) + d.get("collisions", 0)), 4,
+            ),
+            "memo_hits": d.get("memo_hits", 0),
+            "reuse_hits": d.get("reuse_hits", 0),
+            "prewire_hits": d.get("d_prewire_hits", 0),
+            "rows_dispatched": d.get("d_rows_dispatched", 0),
+            # Leaves answered by the process AzEvalCache before the
+            # wire, over all leaves emitted through the evaluator.
+            "eval_cache_hit_rate": round(
+                d.get("d_prewire_hits", 0) / evals, 4
+            ),
+        }
+
+    env_saved = {
+        k: _os.environ.get(k)
+        for k in ("FISHNET_NO_SHARED_AZ_PLANE", "FISHNET_NO_EVAL_CACHE",
+                  "FISHNET_AZ_EVAL_CACHE_CAPACITY")
+    }
+
+    def restore_env():
+        for k, v in env_saved.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+
+    ledger = accounting.install()
+    try:
+        # The fixed workload revisits ~tens of thousands of positions;
+        # the default 4k-entry AZ cache would thrash. Must be set before
+        # the first get_az_cache() call of this process.
+        _os.environ["FISHNET_AZ_EVAL_CACHE_CAPACITY"] = str(1 << 17)
+
+        # -- baseline: the pre-PR pool, every ISSUE-14 feature off ----
+        base_cfg = MctsConfig(
+            batch_capacity=256, adaptive_leaves=False, tree_reuse=False,
+            expansion_memo=0,
+        )
+        _os.environ["FISHNET_NO_SHARED_AZ_PLANE"] = "1"
+        _os.environ["FISHNET_NO_EVAL_CACHE"] = "1"
+        eval_cache.reset_cache()
+        pool = MctsPool(params, base_cfg)
+        pool.warmup()
+        b0 = snap(pool)
+        tv, dt, _ = run_round(pool, ledger, "baseline", min(32, trees), 150)
+        p_base = phase(tv, dt, b0, snap(pool))
+        pool.close()
+        restore_env()
+        _os.environ["FISHNET_AZ_EVAL_CACHE_CAPACITY"] = str(1 << 17)
+        log(f"bench: mcts baseline {p_base}")
+
+        # -- shared plane: cold round, then sustained warm replays ----
+        eval_cache.reset_cache()
+        pool = MctsPool(params, cfg)
+        pool.warmup()
+        s0 = snap(pool)
+        tv, dt, _ = run_round(pool, ledger, "cold", trees, visits)
+        s1 = snap(pool)
+        p_cold = phase(tv, dt, s0, s1)
+        log(f"bench: mcts cold {p_cold}")
+        warm_tv, warm_dt = 0, 0.0
+        for rnd in range(warm_rounds):
+            tv, dt, _ = run_round(pool, ledger, f"warm{rnd}", trees, visits)
+            warm_tv += tv
+            warm_dt += dt
+        s2 = snap(pool)
+        p_warm = phase(warm_tv, warm_dt, s1, s2)
+        pool.close()
+        log(f"bench: mcts warm {p_warm}")
+
+        # -- respawn: fresh pool (memo cold) vs surviving process cache
+        pool = MctsPool(params, cfg)
+        pool.warmup()
+        r0 = snap(pool)
+        tv, dt, _ = run_round(pool, ledger, "respawn", trees, visits)
+        p_respawn = phase(tv, dt, r0, snap(pool))
+        pool.close()
+        log(f"bench: mcts respawn {p_respawn}")
+
+        # -- parity: legacy vs every forced plane rung ----------------
+        from fishnet_tpu.search.az_plane import AZ_RUNGS, AzDispatchPlane
+
+        pcfg = MctsConfig(batch_capacity=64)
+
+        def parity_run(tag, force_rung=None):
+            eval_cache.reset_cache()
+            plane = None
+            if force_rung is None:
+                _os.environ["FISHNET_NO_SHARED_AZ_PLANE"] = "1"
+            else:
+                plane = AzDispatchPlane(params, pcfg, force_rung=force_rung)
+            try:
+                p = MctsPool(params, pcfg, evaluator=plane)
+                try:
+                    return run_round(pool=p, ledger=ledger,
+                                     tag=f"parity-{tag}",
+                                     n_trees=8, n_visits=60)[2]
+                finally:
+                    p.close()
+            finally:
+                if plane is not None:
+                    plane.close()
+                restore_env()
+                _os.environ["FISHNET_AZ_EVAL_CACHE_CAPACITY"] = str(1 << 17)
+
+        legacy = parity_run("legacy")
+        parity = {"positions": 8}
+        for rung, name in enumerate(AZ_RUNGS):
+            parity[f"legacy_vs_{name}"] = legacy == parity_run(
+                name, force_rung=rung
+            )
+        log(f"bench: mcts parity {parity}")
+        ledger_rep = ledger.report()
+    finally:
+        accounting.clear()
+        restore_env()
+
+    az_cache = eval_cache.get_az_cache()
+    warm_vps = p_warm["visits_per_s"]
+    return {
+        "metric": "mcts_warm_visits_per_s",
+        "value": warm_vps,
+        "unit": "visits/s",
+        "mode": "mcts",
+        "trees": trees,
+        "visits": visits,
+        "warm_rounds": warm_rounds,
+        "batch_capacity": cfg.batch_capacity,
+        "speedup_vs_baseline": round(
+            warm_vps / max(1, p_base["visits_per_s"]), 2
+        ),
+        "reference_baseline_visits_per_s": MCTS_REFERENCE_VISITS_PER_S,
+        "speedup_vs_reference": round(
+            warm_vps / MCTS_REFERENCE_VISITS_PER_S, 2
+        ),
+        "baseline": p_base,
+        "cold": p_cold,
+        "warm": p_warm,
+        "respawn": p_respawn,
+        "parity": parity,
+        "ledger": ledger_rep,
+        "cache": az_cache.stats() if az_cache is not None else {},
+    }
+
+
 def bench_search_quality() -> dict:
     """Search QUALITY (depth at node budget) — a property of the search
     tree, not of the transport: the scalar backend walks the same tree
@@ -2043,7 +2334,25 @@ def main(argv=None) -> None:
         "bit parity, and the exactly-once ledger (see "
         "run_cache_replay_bench)",
     )
+    parser.add_argument(
+        "--mcts", action="store_true",
+        help="run the shared-plane batched MCTS benchmark instead of "
+        "the throughput tiers: AZ leaf traffic on the coalesced "
+        "dispatch plane — baseline/cold/warm/respawn phases, sustained "
+        "warm visits/s, batch fill, collision rate, eval-cache hit "
+        "rate, forced-rung parity, and the exactly-once ledger (see "
+        "run_mcts_bench)",
+    )
     args = parser.parse_args(argv)
+
+    if args.mcts:
+        log(
+            f"bench: mcts mode — {MCTS_TREES} trees x {MCTS_VISITS} "
+            f"visits, {MCTS_WARM_ROUNDS} warm rounds..."
+        )
+        summary = run_mcts_bench()
+        emit_summary(summary, args.json_out)
+        return
 
     if args.cluster:
         log(

@@ -12,6 +12,11 @@ variant work always runs on Fairy-Stockfish (src/queue.rs:530-539).
 Topology mirrors SearchService: a single driver thread steps the
 MctsPool (collect leaves from every live search -> one fixed-shape JAX
 microbatch -> expand/backup), while asyncio workers await futures.
+Since ISSUE 14 the pool's microbatches ride the shared AZ dispatch
+plane (search/az_plane.py) — coalesced, pipelined, placement-aware,
+with position-keyed eval reuse — unless FISHNET_NO_SHARED_AZ_PLANE=1
+restores the legacy private jit. ``close()`` tears the pool (and the
+plane this service owns through it) down with the driver thread.
 """
 
 from __future__ import annotations
@@ -106,11 +111,20 @@ class AzMctsService:
         with self._lock:
             return self._visit_rate
 
+    def pool_counters(self) -> Dict:
+        """Tree- and dispatch-side stats (visits, collisions, batch
+        fill, subtree-reuse hits, plane dispatch/prewire counters) —
+        the ops surface bench.py --mcts and the console read."""
+        return self.pool.counters()
+
     def close(self) -> None:
         with self._lock:
             self._stopping = True
         self._wake.set()
         self._thread.join(timeout=60)
+        # The driver is down: release the evaluator (the shared plane's
+        # pipelines and collector when this pool owns its plane).
+        self.pool.close()
 
     # -- driver thread ----------------------------------------------------
 

@@ -54,6 +54,20 @@ def serving_devices(requested=None) -> List[jax.Device]:
     return devs
 
 
+def replicate_params(params, devices: Sequence[jax.Device]) -> List[Dict]:
+    """Place one full replica of a (pytree) param dict on each serving
+    device — the per-shard weight placement both dispatch planes use
+    (doc/sharding.md, doc/search.md): each mesh shard evaluates its own
+    groups' microbatches against its local replica, so a dispatch never
+    crosses devices. Returns one params handle per device, in device
+    order; with a single device this is one ``device_put`` (the
+    single-shard service's existing placement, byte-for-byte)."""
+    return [
+        jax.tree_util.tree_map(lambda a, d=dev: jax.device_put(a, d), params)
+        for dev in devices
+    ]
+
+
 class ShardRouter:
     """Occupancy-weighted pipeline-group -> mesh-slot assignment for the
     placement-aware coalescer (doc/sharding.md).

@@ -394,6 +394,14 @@ class FrontEnd:
                     continue
                 self._waiting.append(callback)
         finally:
+            # Retire the /healthz serving provider before any await: a
+            # hard-cancelled run() must not leave an exited front end
+            # reporting serving state until gc happens to collect it.
+            from fishnet_tpu.telemetry import exporter as _exporter
+
+            _exporter.unregister_health_provider_if(
+                "serving", self._health_provider
+            )
             for task in streams:
                 task.cancel()
             await asyncio.gather(*streams, return_exceptions=True)
@@ -414,7 +422,9 @@ class FrontEnd:
 def _register_frontend_health(frontend: FrontEnd) -> None:
     """Register the serving-state provider with the exporter's
     /healthz. Weakly referenced: a collected front end silently drops
-    out of the report."""
+    out of the report; :meth:`FrontEnd.run` retires it deterministically
+    on exit (gc of a cyclic front end can lag the process by a long
+    time, and an exited front end has no serving state to report)."""
     from fishnet_tpu.telemetry import exporter as _exporter
 
     ref = weakref.ref(frontend)
@@ -425,4 +435,5 @@ def _register_frontend_health(frontend: FrontEnd) -> None:
             return None
         return fe.health_snapshot()
 
+    frontend._health_provider = provide
     _exporter.register_health_provider("serving", provide)

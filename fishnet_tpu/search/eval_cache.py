@@ -70,6 +70,48 @@ def snapshot_path() -> Optional[str]:
     return os.environ.get(SNAPSHOT_ENV) or None
 
 
+def az_net_fingerprint(params) -> int:
+    """64-bit blake2b over an AZ param pytree's raw array bytes — the
+    network-identity salt the shared AZ dispatch plane XORs into every
+    AZ cache key (doc/search.md). Serialization is canonical (leaves
+    hashed in ``jax.tree_util`` flatten order, shape+dtype prefixed), so
+    the same weights always key the same region and AZ entries NEVER
+    collide with NNUE entries: the two families' fingerprints hash
+    disjoint byte streams (param arrays vs the .nnue file) and each key
+    is only ever probed by its own family's plane."""
+    import hashlib
+
+    import jax
+
+    h = hashlib.blake2b(digest_size=8)
+    h.update(b"az-params/1")
+    for leaf in jax.tree_util.tree_leaves(params):
+        arr = np.asarray(leaf)
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return int.from_bytes(h.digest(), "little")
+
+
+#: Odd 64-bit multiplier (golden-ratio) mixing the halfmove clock into
+#: an AZ position key. The AZ input planes encode the clock (plane 17)
+#: but the Zobrist hash does not, so two positions differing only in
+#: clock would alias under a raw-Zobrist key and replay the wrong
+#: policy row. NNUE keys never mix the clock — its features are
+#: piece-square only — so the two families' key schemes differ even
+#: before the fingerprint salt.
+_HALFMOVE_MIX = 0x9E3779B97F4A7C15
+_U64 = (1 << 64) - 1
+
+
+def az_position_key(zobrist: int, halfmove: int) -> int:
+    """The UNSALTED AZ cache key for one position: Zobrist hash mixed
+    with the halfmove clock (the one board fact the AZ planes see that
+    Zobrist omits). The dispatch plane XORs :func:`az_net_fingerprint`
+    on top before probing, so the pool side never needs the weights."""
+    return (zobrist ^ ((halfmove * _HALFMOVE_MIX) & _U64)) & _U64
+
+
 def net_fingerprint(path: str) -> int:
     """64-bit blake2b of the ``.nnue`` file — the network-identity salt
     the service XORs into every cache key. Positions only collide with
@@ -295,11 +337,68 @@ class EvalCache:
         return n
 
 
+#: Default AZ-cache bound. AZ entries are ~300x heavier than NNUE's
+#: (a full fp16 policy row + value vs one int32), so the default is
+#: correspondingly smaller: 4096 entries is ~40 MB of logits payload.
+DEFAULT_AZ_CAPACITY = 1 << 12
+
+
+class AzEvalCache(EvalCache):
+    """Object-valued twin of :class:`EvalCache` for the AZ family: each
+    entry is ``(policy_logits float16 [4672], value float)`` — the
+    EXACT wire payload a device dispatch returns, so substituting a hit
+    for a recomputed row reconstructs bit-identical float32 logits
+    (``.astype(np.float32)`` of the same fp16 bits) and the shared-
+    plane-vs-legacy parity gate holds through warm caches. Striping,
+    generation eviction and stats are all inherited; only the value
+    coercion (objects, not ints) and the per-row probe/insert surface
+    differ. Keyed ``(zobrist ^ halfmove-mix) ^ az_net_fingerprint`` by
+    the AZ dispatch plane (doc/search.md) — the fingerprint keeps AZ
+    and NNUE keys disjoint in principle, and in practice the two
+    families also live in SEPARATE cache instances (:func:`get_az_cache`
+    vs :func:`get_cache`) so their capacity budgets never compete."""
+
+    def insert(self, h: int, value) -> None:
+        s = self._stripe_of(h)
+        gen = self._generation
+        with self._locks[s]:
+            stripe = self._stripes[s]
+            if h not in stripe and len(stripe) >= self._stripe_cap:
+                self._evict_locked(s)
+            stripe[h] = (value, gen)
+        with self._meta_lock:
+            self._insertions += 1
+
+    def probe_many(self, keys) -> List[Optional[object]]:
+        """Per-row object probe: ``out[i]`` is the cached value for
+        ``keys[i]`` or None. One stripe-lock round trip per key; hits
+        refresh the entry's generation like :meth:`probe`."""
+        out: List[Optional[object]] = []
+        hits = 0
+        gen = self._generation
+        for k in keys:
+            h = int(k)
+            s = self._stripe_of(h)
+            with self._locks[s]:
+                ent = self._stripes[s].get(h)
+                if ent is not None:
+                    self._stripes[s][h] = (ent[0], gen)
+            out.append(None if ent is None else ent[0])
+            if ent is not None:
+                hits += 1
+        with self._meta_lock:
+            self._hits += hits
+            self._misses += len(out) - hits
+        return out
+
+
 # -- process-wide singleton -----------------------------------------------
 
 _global_lock = threading.Lock()
 _global_cache: Optional[EvalCache] = None
 _collector_token: Optional[int] = None
+_global_az_cache: Optional[AzEvalCache] = None
+_az_collector_token: Optional[int] = None
 
 
 def _collect_families():
@@ -326,6 +425,59 @@ def _collect_families():
     ]
 
 
+def _collect_az_families():
+    """Registry collector for the AZ twin: same family names, tagged
+    ``family="az"`` so the fleet plane can tell the two reuse caches
+    apart (hit counters, scope-split, are exported by the AZ dispatch
+    plane's collector — mirroring the NNUE service split)."""
+    cache = _global_az_cache
+    if cache is None:
+        return None  # self-unregister after reset_cache()
+    from ..telemetry.registry import counter_family, gauge_family
+
+    st = cache.stats()
+    return [
+        gauge_family(
+            "fishnet_eval_cache_entries",
+            "Live entries in the process-wide eval cache.",
+            st["entries"],
+            labels={"family": "az"},
+        ),
+        counter_family(
+            "fishnet_eval_cache_evictions_total",
+            "Entries evicted from the eval cache (generation sweeps).",
+            st["evictions"],
+            labels={"family": "az"},
+        ),
+    ]
+
+
+def get_az_cache() -> Optional[AzEvalCache]:
+    """The process-wide AZ eval cache, or None when the shared
+    ``FISHNET_NO_EVAL_CACHE=1`` hatch is set. Created on first use;
+    capacity via ``FISHNET_AZ_EVAL_CACHE_CAPACITY``. A separate
+    instance from :func:`get_cache` — the object-valued AZ entries are
+    ~300x heavier, so they get their own (much smaller) budget instead
+    of evicting NNUE's million-entry working set."""
+    if cache_disabled():
+        return None
+    global _global_az_cache, _az_collector_token
+    with _global_lock:
+        if _global_az_cache is None:
+            cap = int(
+                os.environ.get(
+                    "FISHNET_AZ_EVAL_CACHE_CAPACITY", DEFAULT_AZ_CAPACITY
+                )
+            )
+            _global_az_cache = AzEvalCache(capacity=cap)
+            from ..telemetry.registry import REGISTRY
+
+            _az_collector_token = REGISTRY.register_collector(
+                _collect_az_families, name="az-eval-cache"
+            )
+        return _global_az_cache
+
+
 def get_cache() -> Optional[EvalCache]:
     """The process-wide cache, or None when FISHNET_NO_EVAL_CACHE=1.
     Created on first use; capacity via FISHNET_EVAL_CACHE_CAPACITY."""
@@ -347,11 +499,13 @@ def get_cache() -> Optional[EvalCache]:
 
 
 def reset_cache() -> None:
-    """Tear down the process cache (tests / bench cold starts). The
-    registered collector self-unregisters on its next scrape."""
-    global _global_cache
+    """Tear down the process caches — BOTH families; a cold start is a
+    cold start (tests / bench cold runs). The registered collectors
+    self-unregister on their next scrape."""
+    global _global_cache, _global_az_cache
     with _global_lock:
         _global_cache = None
+        _global_az_cache = None
 
 
 # -- warm-restart snapshot --------------------------------------------------

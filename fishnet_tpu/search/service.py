@@ -619,6 +619,54 @@ class _CoalesceTicket:
         self.cache_vals = cache_vals
 
 
+class CoalesceBackend:
+    """The dispatch seam (ISSUE 14): everything _DispatchCoalescer and
+    _AsyncDispatchPipeline need from their owner, extracted so BOTH
+    search families ride the same scheduling/pipelining machinery —
+    SearchService implements it for NNUE alpha-beta microbatches and
+    search/az_plane.py's AzDispatchPlane implements it for AZ/MCTS leaf
+    microbatches (doc/search.md "Two search families, one dispatch
+    plane"). A backend provides:
+
+    Attributes
+      ``_router``        ShardRouter or None (single-shard)
+      ``_n_shards``      serving-mesh shard count (>= 1)
+      ``_n_groups``      pipeline-group / coalesce-lane count
+      ``driver_threads`` threads that call ``submit``/``demand``
+      ``_latency_active``int; > 0 while an interactive best-move search
+                         is in flight (suppresses the demand linger)
+      ``_async_pipes``   per-shard _AsyncDispatchPipeline list (entries
+                         may be None: that shard flushes inline)
+      ``_coalescer``     the backend's _DispatchCoalescer
+
+    Methods
+      ``_dispatch_eval(group, n, rows) -> (values, acct)`` — execute
+        ONE group's microbatch on its shard's device. ``values`` may be
+        any payload the backend's demand-side knows how to slice
+        (plain array, or a _FusedValues holder materialized once).
+      ``_dispatch_segmented(tickets)`` — execute one FUSED dispatch
+        covering several groups' microbatches; assigns each ticket's
+        ``values``/``start``/``seg_size``/``acct``.
+
+    The coalescer/pipeline classes touch the backend through this
+    surface ONLY — ticket lifecycle, shard placement, degradation
+    bookkeeping and span fan-in are family-agnostic."""
+
+    _router = None
+    _n_shards = 1
+    _n_groups = 1
+    driver_threads = 1
+    _latency_active = 0
+    _async_pipes: List[Optional["_AsyncDispatchPipeline"]] = []
+    _coalescer: Optional["_DispatchCoalescer"] = None
+
+    def _dispatch_eval(self, group: int, n: int, rows: int):
+        raise NotImplementedError
+
+    def _dispatch_segmented(self, tickets: List["_CoalesceTicket"]) -> None:
+        raise NotImplementedError
+
+
 class _DispatchCoalescer:
     """Fuses ready pipeline-group microbatches into segmented device
     dispatches to amortize the FIXED per-dispatch transport cost
@@ -660,7 +708,7 @@ class _DispatchCoalescer:
     #: thread exists (its own groups are already all parked).
     MAX_LINGER_S = 0.005
 
-    def __init__(self, svc: "SearchService",
+    def __init__(self, svc: "CoalesceBackend",
                  pinned_width: Optional[int] = None) -> None:
         self._svc = svc
         self._lock = threading.Lock()
@@ -951,7 +999,7 @@ class _AsyncDispatchPipeline:
     #: Ping-pong double buffer: two dispatches in flight, no more.
     DEPTH = 2
 
-    def __init__(self, svc: "SearchService", shard: int = 0,
+    def __init__(self, svc: "CoalesceBackend", shard: int = 0,
                  seq_alloc: Optional["_SeqAllocator"] = None) -> None:
         self._svc = svc
         self._shard = shard
@@ -1184,8 +1232,10 @@ class _AsyncDispatchPipeline:
 MIN_BATCH_CAPACITY = 40
 
 
-class SearchService:
-    """Shared batched-search backend. One instance per client process."""
+class SearchService(CoalesceBackend):
+    """Shared batched-search backend. One instance per client process.
+    Implements :class:`CoalesceBackend` for NNUE alpha-beta microbatches
+    (the AZ family's implementation is search/az_plane.py)."""
 
     def __init__(
         self,

@@ -101,6 +101,17 @@ def unregister_health_provider(name: str) -> None:
         _HEALTH_PROVIDERS.pop(name, None)
 
 
+def unregister_health_provider_if(
+    name: str, fn: Callable[[], Optional[dict]]
+) -> None:
+    """Remove ``name`` only if it still maps to ``fn`` — lets an owner
+    retire its own provider without clobbering a successor registered
+    under the same name."""
+    with _HEALTH_LOCK:
+        if _HEALTH_PROVIDERS.get(name) is fn:
+            _HEALTH_PROVIDERS.pop(name, None)
+
+
 def health_snapshot() -> Tuple[int, Optional[dict]]:
     """(status_code, body) for /healthz; body None means the bare
     liveness ``ok`` (no providers registered)."""

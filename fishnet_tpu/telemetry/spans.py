@@ -27,6 +27,10 @@ named machinery actually runs):
   dispatch's values (fields: seq, width). [dispatch_issue.t,
   dispatch_wait.t + dur] brackets one dispatch's in-flight interval;
   bench.py's overlap-ratio report is computed from these pairs.
+* ``mcts_collect`` — one MctsPool step's tree-side leaf collection:
+  every live PUCT search's selection walks, run before the pooled
+  microbatch rides the shared AZ dispatch plane (search/mcts.py;
+  fields: n, trees, collisions)
 * ``queue_wait``  — one position's dwell in the scheduler's incoming
   queue, from batch enqueue to worker pull (sched/queue.py; fields:
   batch, position_id)
@@ -81,7 +85,8 @@ STAGES = (
 #: Event stages: recorded only when the named machinery runs.
 EVENT_STAGES = (
     "recover", "coalesce", "dispatch_issue", "dispatch_wait",
-    "queue_wait", "submit", "admit", "cache_probe", "drain",
+    "mcts_collect", "queue_wait", "submit", "admit", "cache_probe",
+    "drain",
 )
 
 #: Span-dump header format. /2 added the additive causal-trace fields

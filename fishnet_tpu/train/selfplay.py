@@ -4,9 +4,17 @@ Closes the training loop the reference never had (its nets are opaque
 upstream blobs, SURVEY.md §2): many games play themselves concurrently
 over one MctsPool, so every game's PUCT leaves land in the same device
 microbatches — self-play throughput scales with batch width exactly like
-serving. Each move stores (position planes, normalized root visit
-distribution, side to move); finished games back-fill the outcome as the
-value target. The produced batches feed AzTrainer directly.
+serving. Since ISSUE 14 those microbatches ride the SHARED AZ dispatch
+plane (search/az_plane.py) by default: coalesced, pipelined,
+placement-aware dispatch with position-keyed eval reuse — transposed
+positions across concurrent games resolve pre-wire — while cross-move
+subtree reuse rebases each game's previous tree at every ply (submit
+keys are (start_fen, moves), so the one-ply ancestor always hits).
+Generation is BIT-IDENTICAL plane-on vs FISHNET_NO_SHARED_AZ_PLANE=1
+at a fixed seed (tests/test_mcts_plane.py pins this). Each move stores
+(position planes, normalized root visit distribution, side to move);
+finished games back-fill the outcome as the value target. The produced
+batches feed AzTrainer directly.
 """
 
 from __future__ import annotations

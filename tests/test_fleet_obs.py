@@ -730,3 +730,67 @@ async def test_supervised_fleet_observed_through_a_kill(tmp_path):
     assert doc["stitch"]["traces"] >= 1
     assert doc["slo"], "SLO evaluation missing"
     assert doc["critical_path"]["traces"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Shared-plane MCTS families federate (doc/search.md)
+# ---------------------------------------------------------------------------
+
+
+def test_mcts_tree_families_federate_with_proc_labels():
+    """The MCTS tree-side families ride the standard exposition: a proc
+    that ran an MctsPool federates them through the FleetAggregator
+    with proc labels intact, next to every other family."""
+    import numpy as np
+
+    from fishnet_tpu.models.az_encoding import POLICY_SIZE
+    from fishnet_tpu.search.mcts import MctsConfig, MctsPool
+
+    class _InstantEval:
+        def warmup(self, cap):
+            pass
+
+        def evaluate(self, planes_u8, n, keys=None):
+            return (
+                np.zeros((n, POLICY_SIZE), np.float32),
+                np.zeros(n, np.float32),
+            )
+
+        def close(self):
+            pass
+
+    start = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+    pool = MctsPool(
+        {}, MctsConfig(batch_capacity=32), evaluator=_InstantEval()
+    )
+    sids = [pool.submit(start, [], 20) for _ in range(2)]
+    while pool.active() > 0:
+        pool.step()
+    for sid in sids:
+        pool.harvest(sid)
+    pool.close()
+
+    exporter = MetricsExporter(port=0, registry=reg.REGISTRY)
+    agg = FleetAggregator(targets={"PROC0": exporter.url})
+    try:
+        agg.poll_once()
+        fams = agg.federated_families()
+        for name in (
+            "fishnet_mcts_visits_total",
+            "fishnet_mcts_collisions_total",
+            "fishnet_mcts_subtree_reuse_total",
+            "fishnet_mcts_batch_fill_ratio",
+            "fishnet_mcts_trees_active",
+        ):
+            assert name in fams, name
+            assert fams[name].samples
+            assert all(
+                s.labels.get("proc") == "PROC0" for s in fams[name].samples
+            )
+        visits = sum(
+            s.value for s in fams["fishnet_mcts_visits_total"].samples
+        )
+        assert visits >= 40
+    finally:
+        agg.close()
+        exporter.close()
