@@ -775,12 +775,65 @@ SUMMARY_SCHEMA = {
         "procs", "stale_probe", "slo", "stitch", "critical_path",
         "perfetto",
     ),
+    # Continuous-profiler section, embedded by EVERY mode (ISSUE 15):
+    # where the run's milliseconds went, not just how much it did —
+    # top folded stacks by sample count and per-stage duration
+    # quantiles from fishnet_stage_duration_seconds. bench's main()
+    # arms the plane; a summary produced with it off (direct run_*
+    # calls in tests) still carries the section with enabled=False.
+    "profile": (
+        "enabled", "hz", "samples", "duty_cycle", "top_stacks",
+        "stages",
+    ),
 }
+
+#: Every mode's summary carries the profiler section (validated below).
+for _mode_key in ("top", "overload", "multichip", "cache_replay",
+                  "mcts", "cluster"):
+    SUMMARY_SCHEMA[_mode_key] = SUMMARY_SCHEMA[_mode_key] + ("profile",)
+
+
+def profile_section() -> dict:
+    """The ``profile`` sub-dict for a bench summary: top-10 folded
+    stacks by sample count + per-stage p50/p90/p99 from the live
+    stage-duration histogram. Zero-valued stub when the profiling
+    plane is off (telemetry/profiler.py)."""
+    from fishnet_tpu.telemetry import profiler as _profiler
+
+    prof = _profiler.profiler()
+    if prof is None:
+        return {
+            "enabled": False, "hz": 0.0, "samples": 0,
+            "duty_cycle": 0.0, "top_stacks": [],
+            "stages": _profiler.stage_quantiles(),
+        }
+    wall = max(1e-9, time.monotonic() - prof.started_at)
+    return {
+        "enabled": True,
+        "hz": prof.hz,
+        "samples": prof.samples,
+        "duty_cycle": round(prof.self_seconds / wall, 6),
+        "top_stacks": prof.top_stacks(10),
+        "stages": _profiler.stage_quantiles(),
+    }
 
 
 def validate_summary(summary: dict) -> None:
     """Raise ``ValueError`` if ``summary`` is missing any key the
     emitted-JSON contract (SUMMARY_SCHEMA) promises."""
+    # Every mode requires the "profile" key (in its mode tuple); when
+    # it is an actual section dict, its sub-keys are part of the
+    # contract too (schema-built test stubs may carry a placeholder).
+    prof = summary.get("profile")
+    if isinstance(prof, dict):
+        missing_prof = [
+            f"profile.{k}" for k in SUMMARY_SCHEMA["profile"]
+            if k not in prof
+        ]
+        if missing_prof:
+            raise ValueError(
+                f"bench summary missing keys: {missing_prof}"
+            )
     if summary.get("mode") == "multichip":
         missing = [
             k for k in SUMMARY_SCHEMA["multichip"] if k not in summary
@@ -989,6 +1042,7 @@ def run_overload_bench(
                 "value": round(move_p99, 1) if move_p99 is not None else None,
                 "unit": "ms",
                 "mode": "overload",
+                "profile": profile_section(),
                 "tenants": tenants,
                 "seconds": seconds,
                 "latency": {
@@ -1315,6 +1369,7 @@ def run_cluster_bench(
                 "value": _r(ttfa_p99),
                 "unit": "ms",
                 "mode": "cluster",
+                "profile": profile_section(),
                 "seconds": measured,
                 "processes": {
                     "count": procs,
@@ -1621,6 +1676,7 @@ def run_multichip_bench(
         "value": top["steps_per_s"],
         "unit": "steps/s",
         "mode": "multichip",
+        "profile": profile_section(),
         "seconds": seconds,
         "host_cores": _os.cpu_count(),
         "device_counts": counts,
@@ -1765,6 +1821,7 @@ def run_cache_replay_bench(nodes: int = CACHE_REPLAY_NODES) -> dict:
         "value": round(reduction, 4),
         "unit": "fraction",
         "mode": "cache_replay",
+        "profile": profile_section(),
         "nodes": nodes,
         "positions": len(jobs),
         "off": phase(off_d, off_s),
@@ -2020,6 +2077,7 @@ def run_mcts_bench(
         "value": warm_vps,
         "unit": "visits/s",
         "mode": "mcts",
+        "profile": profile_section(),
         "trees": trees,
         "visits": visits,
         "warm_rounds": warm_rounds,
@@ -2344,6 +2402,18 @@ def main(argv=None) -> None:
         "run_mcts_bench)",
     )
     args = parser.parse_args(argv)
+
+    # Arm the observability plane for the whole run so every mode's
+    # summary carries a live "profile" section (folded stacks + stage
+    # p99s) and per-tenant cost counters accumulate (ISSUE 15). The
+    # sampler self-accounts its duty cycle; see telemetry/profiler.py.
+    from fishnet_tpu import telemetry as _telemetry
+    from fishnet_tpu.telemetry import cost as _cost
+    from fishnet_tpu.telemetry import profiler as _profiler
+
+    _telemetry.enable()
+    _profiler.start()
+    _cost.enable()
 
     if args.mcts:
         log(
@@ -2694,6 +2764,7 @@ def main(argv=None) -> None:
             "unit": "nodes/s",
             "vs_baseline": round(nps / REFERENCE_BASELINE_NPS, 4),
             "psqt_path": service.psqt_path,
+            "profile": profile_section(),
             # Coalescing headline pair (median window): device dispatch
             # calls per pool step and average fused width.
             "dispatches_per_step": traffic.get("dispatches_per_step"),

@@ -117,6 +117,7 @@ class TpuNnueEngine(Engine):
                 # which suppresses the coalescer's batching linger
                 # while they are in flight (doc/resilience.md).
                 lane="throughput" if work.is_analysis else "latency",
+                tenant=getattr(position, "tenant", ""),
             )
         except EngineError:
             raise

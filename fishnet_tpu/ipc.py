@@ -29,7 +29,11 @@ from fishnet_tpu.protocol.types import (
 @dataclass(frozen=True)
 class Position:
     """One position to search: root FEN plus the UCI moves leading to it
-    (ipc.rs:16-26). ``position_id`` is the ply index within the batch."""
+    (ipc.rs:16-26). ``position_id`` is the ply index within the batch.
+    ``tenant`` names the acquire stream the position arrived on (the
+    multi-tenant front end stamps it in sched/queue.py) so device cost
+    is attributable per tenant (telemetry/cost.py); "" means
+    single-tenant/unattributed."""
 
     work: Work
     position_id: int
@@ -38,6 +42,7 @@ class Position:
     root_fen: str
     moves: List[str] = field(default_factory=list)
     url: Optional[str] = None
+    tenant: str = ""
 
 
 @dataclass

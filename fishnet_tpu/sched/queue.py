@@ -23,6 +23,7 @@ Behavioral equivalent of the reference's queue layer (src/queue.rs):
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import time
 import weakref
 from collections import deque
@@ -690,6 +691,16 @@ class QueueState:
             self.logger.error(f"Dropping duplicate incoming batch {batch_id}")
             return
         lane = lane_of_work(batch.work)
+        if tenant:
+            # Stamp the originating tenant onto every position BEFORE
+            # the push loop and the ``sources`` copy below, so both the
+            # first pass and any requeue carry it down to the engine
+            # tier and the cost plane (telemetry/cost.py). Position is
+            # frozen — replace, don't mutate.
+            batch.positions = [
+                p if p is SKIP else dataclasses.replace(p, tenant=tenant)
+                for p in batch.positions
+            ]
         placeholders: List[object] = []
         for pos in batch.positions:
             if pos is SKIP:

@@ -58,6 +58,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from fishnet_tpu import telemetry as _telemetry
+from fishnet_tpu.telemetry import cost as _cost
 from fishnet_tpu.models.az import az_forward
 from fishnet_tpu.models.az_encoding import POLICY_SIZE
 from fishnet_tpu.parallel.mesh import (
@@ -197,6 +198,10 @@ class AzDispatchPlane(CoalesceBackend):
         self._rows_dispatched = 0
         self._slots_dispatched = 0
         self._closed = False
+        # Cost-plane tenant tag for this plane's dispatches (telemetry/
+        # cost.py): AZ leaf traffic is selfplay by default; a serving
+        # deployment mixing tenants can re-tag per plane.
+        self.cost_tenant = "selfplay"
 
         # Same graph/wire as the legacy MctsPool jit (bit-parity).
         az_cfg = cfg.az
@@ -296,7 +301,16 @@ class AzDispatchPlane(CoalesceBackend):
         self._ensure_pipe(shard)
         self._staged[lane] = rows
         try:
-            ticket = self._coalescer.submit(lane, len(miss), rows=len(miss))
+            # Cost plane (telemetry/cost.py): AZ leaf traffic is all
+            # one workload family; the tenant defaults to "selfplay"
+            # but a serving integration can re-tag the plane.
+            owners = (
+                [((self.cost_tenant, "selfplay"), len(miss))]
+                if _cost.enabled() else None
+            )
+            ticket = self._coalescer.submit(
+                lane, len(miss), rows=len(miss), owners=owners
+            )
             # demand() synchronizes and raises dispatch errors; its
             # return slice uses seg_size (0 on solo tickets), so the
             # plane self-slices by ticket.n below instead.
@@ -325,7 +339,7 @@ class AzDispatchPlane(CoalesceBackend):
         seg = self._staged.pop(group)
         shard = self._router.shard_of(group) if self._router else 0
         holder = self._run_rungs(shard, group, [seg])
-        return holder, {"n": n}
+        return holder, {"n": n, "wire_bytes": int(seg.nbytes)}
 
     def _dispatch_segmented(self, tickets) -> None:
         segs = [self._staged.pop(tk.group) for tk in tickets]
@@ -338,7 +352,7 @@ class AzDispatchPlane(CoalesceBackend):
             tk.values = holder
             tk.start = off
             tk.seg_size = len(seg)
-            tk.acct = {"n": tk.n}
+            tk.acct = {"n": tk.n, "wire_bytes": int(seg.nbytes)}
             off += len(seg)
 
     # -- dispatch internals ------------------------------------------------

@@ -41,6 +41,7 @@ from fishnet_tpu.chess.core import NativeCoreError, load
 from fishnet_tpu.protocol.types import Variant
 from fishnet_tpu.nnue import spec
 from fishnet_tpu.nnue.weights import NnueWeights
+from fishnet_tpu.telemetry import cost as _cost
 from fishnet_tpu.telemetry import tracing as _tracing
 from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 
@@ -593,11 +594,12 @@ class _CoalesceTicket:
     __slots__ = (
         "group", "n", "rows", "values", "start", "seg_size", "acct",
         "error", "done", "trace", "hashes", "cache_mask", "cache_vals",
+        "owners", "cost_t0",
     )
 
     def __init__(
         self, group: int, n: int, rows: int, trace=None, hashes=None,
-        cache_mask=None, cache_vals=None,
+        cache_mask=None, cache_vals=None, owners=None,
     ) -> None:
         self.group = group
         self.n = n
@@ -609,6 +611,14 @@ class _CoalesceTicket:
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
         self.trace = trace
+        # Cost attribution (telemetry/cost.py, only when the plane is
+        # on): ``owners`` is the driver's [((tenant, family), n), ...]
+        # table over this microbatch's entries; ``cost_t0`` is the
+        # async pipeline's issue timestamp, stamped by _execute in
+        # defer mode so the decode worker can record the full
+        # issue-to-materialize wall exactly once per dispatch.
+        self.owners = owners
+        self.cost_t0 = 0.0
         # Zobrist hashes of this microbatch's entries (batch order), or
         # None when the eval cache is off: the position-dedup and
         # cache-fill keys for the fused planner (doc/eval-cache.md).
@@ -792,7 +802,7 @@ class _DispatchCoalescer:
 
     def submit(
         self, group: int, n: int, rows: int, trace=None, hashes=None,
-        cache_mask=None, cache_vals=None,
+        cache_mask=None, cache_vals=None, owners=None,
     ) -> _CoalesceTicket:
         """Park a stepped group's microbatch on its SHARD's pending
         list; returns its ticket. May flush (dispatch) on this thread if
@@ -802,7 +812,7 @@ class _DispatchCoalescer:
         ticket."""
         ticket = _CoalesceTicket(
             group, n, rows, trace=trace, hashes=hashes,
-            cache_mask=cache_mask, cache_vals=cache_vals,
+            cache_mask=cache_mask, cache_vals=cache_vals, owners=owners,
         )
         router = self._svc._router
         if router is not None:
@@ -898,11 +908,14 @@ class _DispatchCoalescer:
             return
         self._execute(tickets)
 
-    def _execute(self, tickets: List[_CoalesceTicket]) -> None:
+    def _execute(
+        self, tickets: List[_CoalesceTicket], defer_cost: bool = False
+    ) -> None:
         svc = self._svc
         shard = self._shard_of(tickets[0].group)
         tel = _telemetry.enabled()
-        t0 = time.monotonic() if tel else 0.0
+        cost_on = _cost.enabled()
+        t0 = time.monotonic() if (tel or cost_on) else 0.0
         try:
             if len(tickets) == 1:
                 tk = tickets[0]
@@ -924,6 +937,18 @@ class _DispatchCoalescer:
                 self.fused_dispatches += 1
                 self.coalesced_steps += len(tickets)
         _COALESCE_WIDTH.observe(len(tickets))
+        if cost_on:
+            # Record attribution ONCE per physical dispatch: inline for
+            # the sync path (the wall below includes compute because
+            # demand() materializes later, so this is the issue wall —
+            # still the right per-dispatch split unit); the async
+            # pipeline defers to its decode worker, which sees the full
+            # issue-to-materialize span.
+            if defer_cost:
+                for tk in tickets:
+                    tk.cost_t0 = t0
+            else:
+                _cost.note_tickets(tickets, time.monotonic() - t0)
         for tk in tickets:
             tk.done.set()
         if tel and len(tickets) > 1:
@@ -1155,7 +1180,7 @@ class _AsyncDispatchPipeline:
                 self._slots.release()
                 continue
             try:
-                co._execute(tickets)
+                co._execute(tickets, defer_cost=True)
             except BaseException as err:  # noqa: BLE001 - pipeline teardown
                 # _execute already failed the batch's tickets and
                 # counted the flush error; only non-Exception unwinds
@@ -1216,6 +1241,13 @@ class _AsyncDispatchPipeline:
                 _COALESCE_ERRORS.inc()
             self._mark(-1)
             self._release(lseq % self.DEPTH)
+            if tickets and tickets[0].cost_t0:
+                # Deferred cost record (telemetry/cost.py): the wall
+                # from pack-issue to materialization — transfer +
+                # compute as the device actually experienced it.
+                _cost.note_tickets(
+                    tickets, time.monotonic() - tickets[0].cost_t0
+                )
             if tel:
                 _SPANS.record(
                     "dispatch_wait", t0,
@@ -1766,6 +1798,12 @@ class SearchService(CoalesceBackend):
         self._pending: List[Dict[int, _Pending]] = [{} for _ in range(T)]
         self._submissions: List[List[Tuple]] = [[] for _ in range(T)]
         self._cancelled_tokens: List[set] = [set() for _ in range(T)]
+        # Cost attribution (telemetry/cost.py): pool slot -> (tenant,
+        # family) for live searches, so a stepped batch's per-entry
+        # slot ids map back to owners. Written/popped under _lock at
+        # submit/finish; read lock-free on the owning driver (GIL-
+        # atomic dict gets) only while the cost plane is enabled.
+        self._slot_owner: Dict[int, Tuple[str, str]] = {}
         self._lock = threading.Lock()
         self._warmup_lock = threading.Lock()
         self._warmed = False
@@ -1811,6 +1849,7 @@ class SearchService(CoalesceBackend):
         stop_event: Optional[threading.Event] = None,
         skill_level: int = 20,
         lane: str = "throughput",
+        tenant: str = "",
     ) -> SearchResultData:
         """...with ``stop_event``: setting it (then ``poke()``) stops the
         native search gracefully — the call still returns the partial
@@ -1821,11 +1860,15 @@ class SearchService(CoalesceBackend):
         callers leave the default full strength. ``lane`` is the serving
         lane (resilience/shedding.py): while any "latency" search is in
         flight, the dispatch coalescer skips its cross-thread linger so
-        interactive best-move latency is never taxed to fill batches."""
+        interactive best-move latency is never taxed to fill batches.
+        ``tenant`` attributes this search's device cost when the cost
+        plane is on (telemetry/cost.py); the workload family follows
+        the lane (latency → best-move, throughput → analysis)."""
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         token = object()
         latency = lane == "latency"
+        owner = (tenant, "best-move" if latency else "analysis")
         with self._lock:
             if self._stopping:
                 raise NativeCoreError("search service is shut down")
@@ -1836,7 +1879,8 @@ class SearchService(CoalesceBackend):
             self._rr += 1
             self._submissions[t].append(
                 (root_fen, " ".join(moves), nodes, depth, multipv, future, loop,
-                 movetime_seconds, variant, token, stop_event, skill_level)
+                 movetime_seconds, variant, token, stop_event, skill_level,
+                 owner)
             )
             if latency:
                 self._latency_active += 1
@@ -2292,6 +2336,24 @@ class SearchService(CoalesceBackend):
         self._bucket_slots[t] += size
         self._wire_feature_bytes[t] += feature_bytes
         self._wire_material_bytes[t] += material_bytes
+
+    def _entry_owners(self, g: int, n: int, mask=None):
+        """Cost-plane owner table for a stepped batch: counts the
+        ``(tenant, family)`` owners over group ``g``'s first ``n``
+        packed entries (``self._slot_buf[g]`` per-entry slot ids, just
+        filled by fc_pool_step), optionally restricted to a boolean
+        ``mask`` over those entries. Runs on the owning driver only
+        when ``_cost.enabled()`` — plain dict counting, never on the
+        default path."""
+        slots = self._slot_buf[g][:n]
+        if mask is not None:
+            slots = slots[np.asarray(mask, dtype=bool)]
+        counts: Dict[Tuple[str, str], int] = {}
+        owner_of = self._slot_owner
+        for s in slots:
+            o = owner_of.get(int(s), _cost.UNKNOWN_OWNER)
+            counts[o] = counts.get(o, 0) + 1
+        return list(counts.items())
 
     # -- placement-aware mesh plumbing (doc/sharding.md) -------------------
 
@@ -2910,7 +2972,7 @@ class SearchService(CoalesceBackend):
                 self._submissions[t] = []
             for item in submissions:
                 (fen, moves, nodes, depth, multipv, future, loop, movetime,
-                 variant, token, stop_event, skill) = item
+                 variant, token, stop_event, skill, owner) = item
                 if token in cancelled:
                     continue
                 use_scalar = 1 if self.backend == "scalar" else 0
@@ -2940,6 +3002,7 @@ class SearchService(CoalesceBackend):
                 # poke) identity-checks this map before stopping a slot.
                 with self._lock:
                     pending[slot] = p
+                    self._slot_owner[slot] = owner
                 if movetime is not None:
                     loop.call_soon_threadsafe(
                         loop.call_later, movetime, self._maybe_stop, slot, p
@@ -2957,6 +3020,9 @@ class SearchService(CoalesceBackend):
             # default fast path keeping instrumentation off the device-
             # dispatch critical path (doc/observability.md).
             tel = _telemetry.enabled()
+            # Cost-attribution gate, same discipline: one module-
+            # attribute read when the plane is off (telemetry/cost.py).
+            cost_on = _cost.enabled()
 
             stepped = 0
             for g in groups:
@@ -3088,6 +3154,13 @@ class SearchService(CoalesceBackend):
                         self._miss_hist.record(g, hits, n)
                         if self._cache_steer:
                             self._steer_prefetch(g)
+                        if cost_on and hits:
+                            # Credit cache hits (full or partial) to
+                            # the tenants whose entries hit — device
+                            # work they did not pay for.
+                            _cost.note_cache_hits(
+                                self._entry_owners(g, n, mask=hmask)
+                            )
                         if hits == n:
                             lib.fc_pool_cancel_anchors(self._pool, g)
                             with self._lock:
@@ -3104,6 +3177,7 @@ class SearchService(CoalesceBackend):
                                     group=g, n=n, cache_skip=1,
                                 )
                             continue
+                    owners = self._entry_owners(g, n) if cost_on else None
                     if self._coalescer is not None:
                         # Park the microbatch with the coalescer; it
                         # dispatches fused with other ready groups (or
@@ -3113,12 +3187,18 @@ class SearchService(CoalesceBackend):
                             self._coalescer.submit(
                                 g, n, rows.value, trace=dctx,
                                 hashes=hashes, cache_mask=hmask,
-                                cache_vals=hvals,
+                                cache_vals=hvals, owners=owners,
                             ),
                             dctx, hashes, hmask,
                         )
                     else:
+                        t0c = time.monotonic() if cost_on else 0.0
                         values, acct = self._dispatch_eval(g, n, rows.value)
+                        if cost_on:
+                            _cost.note_dispatch(
+                                owners, n, _cost._acct_wire_bytes(acct),
+                                time.monotonic() - t0c,
+                            )
                         self._apply_acct(t, acct)
                         inflight[g] = (n, values, dctx, hashes, hmask)
                     if tel:
@@ -3162,6 +3242,7 @@ class SearchService(CoalesceBackend):
         )
         with self._lock:
             pending = self._pending[t].pop(slot, None)
+            self._slot_owner.pop(slot, None)
         if pending is None:
             lib.fc_pool_release(self._pool, slot)
             return

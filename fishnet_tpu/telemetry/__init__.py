@@ -88,9 +88,17 @@ def disable() -> None:
 def start_exporter(port: int, host: str = "127.0.0.1"):
     """The one-call opt-in: enable span recording, arm the SIGUSR2 dump
     (where the platform has it), and serve ``/metrics`` on ``port``
-    (0 = ephemeral). Returns the :class:`MetricsExporter`."""
+    (0 = ephemeral). ``FISHNET_PROFILE=1`` additionally arms the
+    continuous profiling plane (sampling profiler + stage-duration
+    histograms + cost attribution — telemetry/profiler.py, cost.py).
+    Returns the :class:`MetricsExporter`."""
     from fishnet_tpu.telemetry.exporter import MetricsExporter
 
     enable()
     install_signal_dump()
+    from fishnet_tpu.telemetry import cost as _cost
+    from fishnet_tpu.telemetry import profiler as _profiler
+
+    if _profiler.maybe_start_from_env() is not None:
+        _cost.enable()
     return MetricsExporter(port=port, host=host)

@@ -13,6 +13,12 @@ Endpoints:
 * ``GET /metrics`` — Prometheus text exposition (version 0.0.4)
 * ``GET /json``    — JSON snapshot of the same families
 * ``GET /spans``   — current flight-recorder contents as JSON
+* ``GET /profile`` — continuous-profiler snapshot (JSON: folded
+  stacks, per-role sample counts, stage-duration quantiles, the
+  sampler's own duty cycle). ``?format=collapsed`` returns the
+  classic ``role;frame;...;frame count`` text for ``flamegraph.pl``
+  or speedscope. 503 with a JSON hint while ``FISHNET_PROFILE`` is
+  not armed (telemetry/profiler.py).
 * ``GET /trace``   — same contents as a Chrome/Perfetto trace (load
   the response body at https://ui.perfetto.dev)
 * ``GET /healthz`` — serving-state probe. With no registered health
@@ -228,7 +234,7 @@ def _make_handler(
             self._send(200, content_type, body)
 
         def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             try:
                 if path == "/metrics":
                     self._scrape(lambda: (
@@ -257,6 +263,13 @@ def _make_handler(
                         "spans": RECORDER.spans(),
                     }).encode()
                     self._send(200, "application/json", body)
+                elif path == "/profile":
+                    from fishnet_tpu.telemetry import profiler as _profiler
+
+                    status, content_type, body = (
+                        _profiler.render_endpoint(query)
+                    )
+                    self._send(status, content_type, body)
                 elif path in extra_routes:
                     status, content_type, body = extra_routes[path]()
                     self._send(status, content_type, body)

@@ -84,7 +84,7 @@ class _ProcState:
 
     __slots__ = (
         "name", "url", "up", "first_seen", "last_ok", "last_error",
-        "scrapes", "errors", "families", "incarnations",
+        "scrapes", "errors", "families", "incarnations", "profile",
     )
 
     def __init__(self, name: str, url: str, now: float) -> None:
@@ -99,6 +99,9 @@ class _ProcState:
         self.families: Dict[str, MetricFamily] = {}
         # pid -> _Incarnation, insertion-ordered (dict preserves it).
         self.incarnations: Dict[int, _Incarnation] = {}
+        # Latest /profile snapshot (only with profiles=True; None when
+        # the target's profiling plane is off — its /profile 503s).
+        self.profile: Optional[dict] = None
 
     def age_s(self, now: float) -> float:
         return now - (self.last_ok if self.last_ok is not None
@@ -144,11 +147,17 @@ class FleetAggregator:
         slo_engine: Optional[SLOEngine] = None,
         registry: Optional[MetricsRegistry] = None,
         journal_dir: Optional[str] = None,
+        profiles: bool = False,
     ) -> None:
         self._static = dict(targets or {})
         self._targets_fn = targets_fn
         self.poll_interval = poll_interval
         self.scrape_timeout = scrape_timeout
+        # With profiles=True each poll also pulls /profile per target
+        # (hottest-stacks console panel). Kept opt-in: profile bodies
+        # are larger than /json and most targets run unprofiled (their
+        # /profile 503s, which is recorded as "off", never an error).
+        self.profiles = profiles
         # Batch-span journals (<name>.journal.jsonl, written by the
         # children via --spans-journal): tailed every poll so the spans
         # a SIGKILLed process recorded AFTER the last scrape still
@@ -208,6 +217,7 @@ class FleetAggregator:
                 pass
         now = time.time()
         results: Dict[str, Tuple[Optional[dict], Optional[dict], str]] = {}
+        profiles: Dict[str, Optional[dict]] = {}
         for name, url in targets.items():
             metrics = spans = None
             err = ""
@@ -217,6 +227,11 @@ class FleetAggregator:
             except Exception as exc:  # noqa: BLE001 - scrape races SIGKILL
                 err = f"{type(exc).__name__}: {exc}"
             results[name] = (metrics, spans, err)
+            if self.profiles and metrics is not None:
+                try:
+                    profiles[name] = self._get_json(url + "/profile")
+                except Exception:  # noqa: BLE001 - 503 = plane off
+                    profiles[name] = None
         journal_batches = self._read_journals()
         with self._lock:
             self._polls += 1
@@ -236,6 +251,8 @@ class FleetAggregator:
                 st.last_ok = now
                 st.last_error = None
                 st.families = self._parse_families(metrics)
+                if self.profiles:
+                    st.profile = profiles.get(name)
                 if spans is not None and "pid" in spans:
                     pid = int(spans["pid"])
                     inc = st.incarnations.get(pid)
@@ -280,9 +297,21 @@ class FleetAggregator:
         pattern = os.path.join(self.journal_dir, "*.journal.jsonl")
         for path in sorted(glob.glob(pattern)):
             name = os.path.basename(path)[: -len(".journal.jsonl")]
+            offset = self._journal_offsets.get(path, 0)
             try:
+                if offset and os.path.getsize(path) < offset:
+                    # Rotation/truncation between polls: the file shrank
+                    # below our cursor, so the journal restarted (crash
+                    # dump rewrote it, or logrotate). Seeking past EOF
+                    # would read b"" forever — restart from the top; the
+                    # journal's header line re-establishes the
+                    # incarnation, and duplicate spans are impossible
+                    # because the old content is gone.
+                    offset = 0
+                    self._journal_offsets[path] = 0
+                    self._journal_heads.pop(path, None)
                 with open(path, "rb") as fp:
-                    fp.seek(self._journal_offsets.get(path, 0))
+                    fp.seek(offset)
                     chunk = fp.read()
             except OSError:
                 continue
@@ -525,8 +554,34 @@ def _fmt(v: Optional[float], fmt: str = "{:.0f}") -> str:
     return "-" if v is None else fmt.format(v)
 
 
-def render_console(agg: FleetAggregator) -> str:
-    """One console frame: per-proc serving state + SLO table."""
+def _profile_panel(procs) -> List[str]:
+    """Per-proc top-5 hottest stacks from the latest /profile scrape
+    (--profiles). Shows each stack's role, share of samples, and leaf
+    frame — the deepest frame is where self time accrues; the full
+    stacks stay on /profile?format=collapsed."""
+    lines: List[str] = ["", "HOT STACKS (top 5 per proc, /profile)"]
+    for name, st in procs:
+        prof = st.profile
+        if prof is None or not prof.get("enabled"):
+            lines.append(f"{name:<10} profiling off")
+            continue
+        lines.append(
+            f"{name:<10} {prof.get('samples', 0)} samples @ "
+            f"{prof.get('hz', 0):g} Hz  duty "
+            f"{prof.get('duty_cycle', 0.0):.2%}"
+        )
+        for row in (prof.get("stacks") or [])[:5]:
+            stack = row.get("stack") or ["?"]
+            lines.append(
+                f"  {row.get('share', 0.0):>6.1%} {row.get('role', '?'):<9} "
+                f"{stack[-1]}"
+            )
+    return lines
+
+
+def render_console(agg: FleetAggregator, profiles: bool = False) -> str:
+    """One console frame: per-proc serving state + SLO table (+ the
+    hottest-stacks panel with ``profiles=True``)."""
     now = time.time()
     lines: List[str] = []
     with agg._lock:
@@ -565,6 +620,8 @@ def render_console(agg: FleetAggregator) -> str:
             if not st.up and st.last_error:
                 lines.append(f"  !! {name}: {st.last_error}")
         slo_rows = agg.slo.evaluate(now)
+        if profiles:
+            lines.extend(_profile_panel(procs))
     lines.append("")
     lines.append(f"{'SLO':<20} {'OBJ':>6} {'STATUS':<8} WINDOWS")
     for row in slo_rows:
@@ -583,10 +640,11 @@ def run_console(
     interval: float = 1.0,
     once: bool = False,
     out=sys.stdout,
+    profiles: bool = False,
 ) -> None:
     """Render the console in place until interrupted (or once)."""
     while True:
-        frame = render_console(agg)
+        frame = render_console(agg, profiles=profiles)
         if once:
             out.write(frame + "\n")
             return
@@ -629,6 +687,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true",
         help="with --once: print the /fleet JSON document instead",
     )
+    parser.add_argument(
+        "--profiles", action="store_true",
+        help="also scrape each target's /profile and show a per-proc "
+             "top-5 hottest-stacks panel (targets with the profiling "
+             "plane off show 'profiling off'); default table unchanged",
+    )
     args = parser.parse_args(argv)
     static: Dict[str, str] = {}
     for i, t in enumerate(args.targets):
@@ -643,6 +707,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         targets=static,
         targets_fn=port_dir_targets(args.port_dir) if args.port_dir else None,
         poll_interval=args.interval,
+        profiles=args.profiles,
     )
     if args.serve is not None:
         exporter = agg.serve(args.serve)
@@ -653,10 +718,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.json:
                 print(json.dumps(agg.fleet_doc(), indent=2))
             else:
-                run_console(agg, once=True)
+                run_console(agg, once=True, profiles=args.profiles)
             return 0
         agg.start()
-        run_console(agg, interval=max(0.2, args.interval))
+        run_console(
+            agg, interval=max(0.2, args.interval), profiles=args.profiles
+        )
     except KeyboardInterrupt:
         pass
     finally:

@@ -1,0 +1,424 @@
+"""Perf-regression sentinel over the checked-in bench artifacts.
+
+The repo accumulates one bench artifact per run next to the code it
+measured (``BENCH_rNN.json``, ``MULTICHIP_rNN.json``,
+``CLUSTER_rNN.json``, ``MCTS_rNN.json``) but until now nothing read
+them back: a PR could quietly drop the warm-cache hit rate or inflate
+move p99 and CI would stay green. This module is the trajectory
+check — ``python -m fishnet_tpu.telemetry.regress``:
+
+* ingests every artifact into one normalized series store keyed
+  ``(mode, metric)`` with one point per run (``rNN`` from the
+  filename). Modern artifacts are flat summary dicts (bench.py
+  SUMMARY_SCHEMA); legacy wrappers (r01–r05 era: ``{"cmd", "rc",
+  "tail"}`` with the summary truncated inside ``tail``) contribute
+  whatever scalars a conservative regex can still recover, and are
+  otherwise counted as ingested-without-series;
+* knows each headline metric's DIRECTION and noise band — nps up,
+  p99 down, ledger-lost exactly 0, parity exactly true — and each
+  metric's SEVERITY: ``gate`` fails the build, ``watch`` prints but
+  never fails (chaos-noisy or 1-core-host-distorted series);
+* prints a trend table (oldest → newest per series, Δ vs prior run),
+  writes ``REGRESS_rNN.json`` next to the bench artifacts, and exits
+  nonzero on any gated regression.
+
+Exit codes (CI contract, doc/observability.md "Regression sentinel"):
+
+* **0** — no gated regression (watch-level drifts allowed)
+* **1** — at least one gated regression (delta beyond band against
+  the metric's direction, a nonzero must-be-zero, a false
+  must-be-true)
+* **2** — usage / environment error (no artifacts found, bad --root)
+
+A regression is judged on the LATEST run of each series vs the nearest
+prior run that carries the metric (series have gaps: not every bench
+mode runs every PR). Bands are fractional for directional metrics
+(|Δ|/prior) and exact for zero/true metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "SERIES_SPECS",
+    "Spec",
+    "build_report",
+    "ingest",
+    "main",
+]
+
+_RUN_RE = re.compile(r"_r(\d+)\.json$")
+
+
+# ---------------------------------------------------------------------------
+# Series specs: what we track, which way is good, how much noise is fine
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Spec:
+    """One tracked series. ``path`` is a dotted path into the artifact
+    (lists resolve to their length — the ledger ``lost``/``duplicated``
+    convention — and bools to 0/1). ``direction``:
+
+    * ``up``   — bigger is better; regression = drop > ``band``
+    * ``down`` — smaller is better; regression = rise > ``band``
+    * ``zero`` — must be exactly 0 on the latest run
+    * ``true`` — must be exactly 1 (truthy) on the latest run
+
+    ``band`` is the fractional noise allowance for up/down (0.15 =
+    15%). ``severity``: ``gate`` exits nonzero, ``watch`` only
+    reports."""
+
+    prefix: str  # artifact family: BENCH / MULTICHIP / CLUSTER / MCTS
+    metric: str  # series name within the family
+    path: str
+    direction: str
+    band: float = 0.10
+    severity: str = "gate"
+
+
+SERIES_SPECS: Tuple[Spec, ...] = (
+    # -- BENCH (bench.py single-process modes) ---------------------------
+    # Headline metric: r06 is cache_replay (warm_dispatch_reduction,
+    # fraction, 1.0 = every warm dispatch eliminated).
+    Spec("BENCH", "headline_value", "value", "up", 0.10, "gate"),
+    Spec("BENCH", "warm_eval_cache_hit_rate",
+         "warm.eval_cache_hit_rate", "up", 0.05, "gate"),
+    Spec("BENCH", "warm_skipped_dispatches",
+         "warm.skipped_dispatches", "up", 0.15, "watch"),
+    Spec("BENCH", "nodes_per_eval", "off.nodes_per_eval", "up", 0.15,
+         "watch"),
+    Spec("BENCH", "ledger_lost", "ledger.lost", "zero", 0.0, "gate"),
+    Spec("BENCH", "ledger_duplicated", "ledger.duplicated", "zero",
+         0.0, "gate"),
+    Spec("BENCH", "parity_off_vs_warm", "parity.off_vs_warm", "true",
+         0.0, "gate"),
+    # -- MULTICHIP (mesh serving; 1-core host → throughput is noisy) -----
+    Spec("MULTICHIP", "steps_per_s", "value", "up", 0.20, "watch"),
+    Spec("MULTICHIP", "efficiency_8dev",
+         "scaling.efficiency_by_devices.8", "up", 0.25, "watch"),
+    Spec("MULTICHIP", "parity_bit_identical", "parity.bit_identical",
+         "true", 0.0, "gate"),
+    Spec("MULTICHIP", "degradation_ledger_lost",
+         "degradation.ledger.lost", "zero", 0.0, "gate"),
+    Spec("MULTICHIP", "degradation_ledger_duplicated",
+         "degradation.ledger.duplicated", "zero", 0.0, "gate"),
+    # -- CLUSTER (multi-process chaos harness; latencies ride chaos) -----
+    Spec("CLUSTER", "ttfa_p99_ms", "value", "down", 0.40, "watch"),
+    Spec("CLUSTER", "move_p99_ms", "latency.move_p99_ms", "down", 0.50,
+         "gate"),
+    Spec("CLUSTER", "analysis_first_p99_ms",
+         "latency.analysis_first_p99_ms", "down", 0.50, "watch"),
+    Spec("CLUSTER", "fleet_ledger_lost", "fleet_ledger.lost", "zero",
+         0.0, "gate"),
+    Spec("CLUSTER", "fleet_ledger_duplicated",
+         "fleet_ledger.duplicated", "zero", 0.0, "gate"),
+    Spec("CLUSTER", "fleet_ledger_clean", "fleet_ledger.clean", "true",
+         0.0, "gate"),
+    Spec("CLUSTER", "recovery_within_bound", "recovery.within_bound",
+         "true", 0.0, "gate"),
+    Spec("CLUSTER", "drain_all_zero", "drain.all_zero", "true", 0.0,
+         "gate"),
+    # -- MCTS (shared-plane AZ bench) ------------------------------------
+    Spec("MCTS", "warm_visits_per_s", "value", "up", 0.20, "gate"),
+    Spec("MCTS", "cold_visits_per_s", "cold.visits_per_s", "up", 0.25,
+         "watch"),
+    Spec("MCTS", "respawn_visits_per_s", "respawn.visits_per_s", "up",
+         0.25, "watch"),
+    Spec("MCTS", "warm_batch_fill", "warm.batch_fill_ema", "up", 0.25,
+         "watch"),
+    Spec("MCTS", "speedup_vs_reference", "speedup_vs_reference", "up",
+         0.20, "watch"),
+)
+
+#: Legacy-tail recovery (BENCH r01–r05 wrappers): ``key`` regexes over
+#: the truncated stdout tail → series. Conservative: first match only,
+#: and the series are all watch-severity (a truncated tail's first
+#: occurrence may come from a per-window block, not the run summary).
+_LEGACY_BENCH_PATTERNS: Tuple[Tuple[str, re.Pattern], ...] = (
+    ("legacy_nodes_per_eval",
+     re.compile(r'"nodes_per_eval":\s*([0-9.]+)')),
+    ("legacy_steps_per_s", re.compile(r'"steps_per_s":\s*([0-9.]+)')),
+    ("legacy_window_nps_max",
+     re.compile(r'"window_nps":\s*\[([0-9, ]+)\]')),
+)
+
+
+# ---------------------------------------------------------------------------
+# Ingestion
+# ---------------------------------------------------------------------------
+
+
+def _resolve(doc: dict, path: str) -> Optional[float]:
+    """Dotted-path lookup normalized to a float: lists → len, bools →
+    0/1, missing or non-numeric → None."""
+    cur: object = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool):
+        return 1.0 if cur else 0.0
+    if isinstance(cur, list):
+        return float(len(cur))
+    if isinstance(cur, (int, float)):
+        return float(cur)
+    return None
+
+
+@dataclass
+class _Series:
+    spec: Spec
+    # run label ("r01") -> (value, source file)
+    points: Dict[str, Tuple[float, str]] = field(default_factory=dict)
+
+
+def _legacy_bench_series(run: str, fname: str, doc: dict,
+                         store: Dict[str, _Series]) -> int:
+    tail = doc.get("tail")
+    if not isinstance(tail, str):
+        return 0
+    found = 0
+    for metric, pat in _LEGACY_BENCH_PATTERNS:
+        m = pat.search(tail)
+        if not m:
+            continue
+        if metric == "legacy_window_nps_max":
+            vals = [float(x) for x in m.group(1).split(",") if x.strip()]
+            if not vals:
+                continue
+            value = max(vals)
+        else:
+            value = float(m.group(1))
+        key = f"BENCH/{metric}"
+        if key not in store:
+            store[key] = _Series(
+                Spec("BENCH", metric, "(legacy-tail)", "up", 0.30,
+                     "watch")
+            )
+        store[key].points[run] = (value, fname)
+        found += 1
+    return found
+
+
+def ingest(root: str) -> Tuple[Dict[str, _Series], List[dict]]:
+    """Scan ``root`` for bench artifacts; returns (series store,
+    per-artifact ingestion log)."""
+    store: Dict[str, _Series] = {}
+    log: List[dict] = []
+    prefixes = sorted({s.prefix for s in SERIES_SPECS})
+    for prefix in prefixes:
+        for path in sorted(glob.glob(os.path.join(root, f"{prefix}_r*.json"))):
+            fname = os.path.basename(path)
+            m = _RUN_RE.search(fname)
+            if not m:
+                continue
+            run = f"r{int(m.group(1)):02d}"
+            try:
+                with open(path, encoding="utf-8") as fp:
+                    doc = json.load(fp)
+            except (OSError, ValueError) as err:
+                log.append({"file": fname, "error": repr(err)})
+                continue
+            n = 0
+            if isinstance(doc, dict) and "mode" in doc:
+                for spec in SERIES_SPECS:
+                    if spec.prefix != prefix:
+                        continue
+                    value = _resolve(doc, spec.path)
+                    if value is None:
+                        continue
+                    key = f"{prefix}/{spec.metric}"
+                    store.setdefault(key, _Series(spec))
+                    store[key].points[run] = (value, fname)
+                    n += 1
+            elif prefix == "BENCH":
+                n = _legacy_bench_series(run, fname, doc, store)
+            log.append({"file": fname, "run": run, "series": n,
+                        "legacy": "mode" not in doc})
+    return store, log
+
+
+# ---------------------------------------------------------------------------
+# Judgement
+# ---------------------------------------------------------------------------
+
+
+def _judge(series: _Series) -> dict:
+    """Evaluate one series' latest point against its spec; returns the
+    report row (verdict: ok / regression / single-point / empty)."""
+    spec = series.spec
+    runs = sorted(series.points)
+    row: dict = {
+        "metric": f"{spec.prefix}/{spec.metric}",
+        "path": spec.path,
+        "direction": spec.direction,
+        "band": spec.band,
+        "severity": spec.severity,
+        "points": {
+            r: series.points[r][0] for r in runs
+        },
+    }
+    if not runs:
+        row["verdict"] = "empty"
+        return row
+    latest_run = runs[-1]
+    latest = series.points[latest_run][0]
+    row["latest_run"] = latest_run
+    row["latest"] = latest
+    if spec.direction == "zero":
+        row["verdict"] = "ok" if latest == 0.0 else "regression"
+        if latest != 0.0:
+            row["detail"] = f"{spec.path} must be 0, got {latest:g}"
+        return row
+    if spec.direction == "true":
+        row["verdict"] = "ok" if latest == 1.0 else "regression"
+        if latest != 1.0:
+            row["detail"] = f"{spec.path} must be true, got {latest:g}"
+        return row
+    if len(runs) < 2:
+        row["verdict"] = "single-point"
+        return row
+    prior_run = runs[-2]
+    prior = series.points[prior_run][0]
+    row["prior_run"] = prior_run
+    row["prior"] = prior
+    if prior == 0.0:
+        # A zero baseline makes the fractional band meaningless: any
+        # move in the bad direction on a guarded metric is flagged.
+        bad = (latest < 0) if spec.direction == "up" else (latest > 0)
+        frac = 0.0
+    else:
+        frac = (latest - prior) / abs(prior)
+        bad = (
+            frac < -spec.band if spec.direction == "up"
+            else frac > spec.band
+        )
+    row["delta_frac"] = round(frac, 4)
+    row["verdict"] = "regression" if bad else "ok"
+    if bad:
+        arrow = "dropped" if spec.direction == "up" else "rose"
+        row["detail"] = (
+            f"{spec.path} {arrow} {abs(frac):.1%} "
+            f"({prior:g} @ {prior_run} -> {latest:g} @ {latest_run}; "
+            f"band {spec.band:.0%})"
+        )
+    return row
+
+
+def build_report(root: str) -> dict:
+    store, log = ingest(root)
+    rows = [_judge(s) for s in store.values()]
+    rows.sort(key=lambda r: r["metric"])
+    regressions = [r for r in rows if r["verdict"] == "regression"]
+    gated = [r for r in regressions if r["severity"] == "gate"]
+    return {
+        "tool": "fishnet_tpu.telemetry.regress",
+        "format": "fishnet-regress/1",
+        "root": os.path.abspath(root),
+        "artifacts": log,
+        "artifacts_ingested": len(log),
+        "series_tracked": len(rows),
+        "series": rows,
+        "regressions": [r["metric"] for r in regressions],
+        "gated_regressions": [r["metric"] for r in gated],
+        "status": "regression" if gated else "ok",
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _next_out_path(root: str) -> str:
+    ns = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(root, "REGRESS_r*.json"))
+        if (m := _RUN_RE.search(os.path.basename(p)))
+    ]
+    return os.path.join(root, f"REGRESS_r{(max(ns) if ns else 0) + 1:02d}.json")
+
+
+def _print_table(report: dict) -> None:
+    print(f"regress: {report['artifacts_ingested']} artifacts, "
+          f"{report['series_tracked']} series tracked "
+          f"(root {report['root']})")
+    hdr = (f"{'metric':44} {'dir':5} {'sev':6} {'trend':28} "
+           f"{'Δ':>8}  verdict")
+    print(hdr)
+    print("-" * len(hdr))
+    for row in report["series"]:
+        pts = row["points"]
+        runs = sorted(pts)
+        shown = runs[-4:]
+        trend = " ".join(f"{pts[r]:g}" for r in shown)
+        if len(runs) > 4:
+            trend = "… " + trend
+        delta = (
+            f"{row['delta_frac']:+.1%}" if "delta_frac" in row else "-"
+        )
+        mark = {"ok": "ok", "single-point": "·", "regression": "REGRESS"}[
+            row["verdict"]
+        ]
+        if row["verdict"] == "regression" and row["severity"] == "watch":
+            mark = "regress (watch)"
+        print(f"{row['metric']:44} {row['direction']:5} "
+              f"{row['severity']:6} {trend:28} {delta:>8}  {mark}")
+    for row in report["series"]:
+        if row["verdict"] == "regression":
+            print(f"  ! {row.get('detail', row['metric'])}"
+                  f" [{row['severity']}]")
+    print(f"status: {report['status']}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m fishnet_tpu.telemetry.regress",
+        description="Bench-artifact perf-regression sentinel "
+                    "(doc/observability.md).",
+    )
+    ap.add_argument("--root", default=".",
+                    help="directory holding the bench artifacts "
+                         "(default: cwd)")
+    ap.add_argument("--out", default=None,
+                    help="report path (default: next REGRESS_rNN.json "
+                         "under --root)")
+    ap.add_argument("--no-write", action="store_true",
+                    help="judge and print only; write no report file")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report JSON instead of the "
+                         "trend table")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.root):
+        print(f"regress: no such directory: {args.root}", file=sys.stderr)
+        return 2
+    report = build_report(args.root)
+    if report["artifacts_ingested"] == 0:
+        print(f"regress: no bench artifacts under {report['root']}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        _print_table(report)
+    if not args.no_write:
+        out = args.out or _next_out_path(args.root)
+        with open(out, "w", encoding="utf-8") as fp:
+            json.dump(report, fp, indent=1)
+            fp.write("\n")
+        print(f"wrote {out}")
+    return 1 if report["status"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

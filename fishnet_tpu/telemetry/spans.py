@@ -105,6 +105,22 @@ JOURNAL_FORMAT = "fishnet-spans-journal/1"
 #: magnitude hotter, so they stay ring-only.
 _GLOBAL_TRACE = re.compile(r"^[0-9a-f]{16}$")
 
+#: Stage-duration observer (telemetry/profiler.py installs one feeding
+#: ``fishnet_stage_duration_seconds{stage}``). None by default, so a
+#: ``record()`` call pays exactly one module-attribute read for it —
+#: the same gate discipline as ``telemetry.enabled()``; with the
+#: profiling plane off there is zero extra hot-path work.
+STAGE_OBSERVER = None
+
+
+def set_stage_observer(fn) -> None:
+    """Install (or clear, with None) the per-span stage-duration
+    observer: ``fn(stage, duration_seconds)`` runs inside ``record()``
+    on the recording thread, so it must be lock-free on its own hot
+    path (the profiler's histogram uses per-thread cells)."""
+    global STAGE_OBSERVER
+    STAGE_OBSERVER = fn
+
 
 class _Ring:
     """Single-writer fixed ring. The writer thread owns all mutation;
@@ -186,6 +202,9 @@ class SpanRecorder:
             fields["links"] = [list(lk) for lk in links]
         dur = time.monotonic() - started
         ring.append((stage, started, dur, fields))
+        obs = STAGE_OBSERVER
+        if obs is not None:
+            obs(stage, dur)
         if (
             self._journal is not None
             and trace is not None

@@ -206,7 +206,7 @@ class _StubCoalescer:
         self._lock = threading.Lock()
         self.executed = []
 
-    def _execute(self, tickets):
+    def _execute(self, tickets, defer_cost=False):
         with self._lock:
             self.executed.append(tickets)
         for tk in tickets:

@@ -794,3 +794,120 @@ def test_mcts_tree_families_federate_with_proc_labels():
     finally:
         agg.close()
         exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# Journal robustness + the --profiles console panel (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_tolerates_torn_partial_tail(tmp_path):
+    """A crash mid-write leaves a newline-less torn tail: the reader
+    must consume only complete lines, leave the cursor before the torn
+    one, and — once the line is completed — deliver that span exactly
+    once on the next poll."""
+    from fishnet_tpu.telemetry.spans import SpanRecorder
+    from fishnet_tpu.telemetry.tracing import batch_root
+
+    journal = tmp_path / "PROC0.journal.jsonl"
+    rec = SpanRecorder()
+    rec.journal_to(str(journal))
+    rec.record(
+        "acquire", time.monotonic(), trace=batch_root("unit-a"),
+        batch="unit-a",
+    )
+    rec.journal_close()
+    full = journal.read_bytes()
+    lines = full.splitlines(keepends=True)
+    torn = lines[-1]
+    journal.write_bytes(b"".join(lines[:-1]) + torn[: len(torn) // 2])
+
+    agg = FleetAggregator(targets={}, journal_dir=str(tmp_path))
+    try:
+        agg.poll_once()  # must not raise, must not consume the torn tail
+        spans = agg.stitched()["spans"]
+        assert [s for s in spans if s["stage"] == "acquire"] == []
+        # The writer completes the line: the span arrives, exactly once.
+        journal.write_bytes(full)
+        agg.poll_once()
+        spans = agg.stitched()["spans"]
+        assert len([s for s in spans if s["stage"] == "acquire"]) == 1
+    finally:
+        agg.close()
+
+
+def test_journal_truncation_between_polls_resets_cursor(tmp_path):
+    """Rotation/truncation regression: when the journal shrinks below
+    the aggregator's cursor (logrotate, crash-dump rewrite), the reader
+    must restart from offset 0 instead of seeking past EOF and reading
+    nothing forever."""
+    from fishnet_tpu.telemetry.spans import SpanRecorder
+    from fishnet_tpu.telemetry.tracing import batch_root
+
+    journal = tmp_path / "PROC0.journal.jsonl"
+    rec = SpanRecorder()
+    rec.journal_to(str(journal))
+    for i in range(3):
+        rec.record(
+            "acquire", time.monotonic(), trace=batch_root(f"unit-{i}"),
+            batch=f"unit-{i}",
+        )
+    rec.journal_close()
+
+    agg = FleetAggregator(targets={}, journal_dir=str(tmp_path))
+    try:
+        agg.poll_once()
+        spans = agg.stitched()["spans"]
+        assert len([s for s in spans if s["stage"] == "acquire"]) == 3
+
+        # The journal restarts smaller than the old cursor.
+        journal.unlink()
+        rec2 = SpanRecorder()
+        rec2.journal_to(str(journal))
+        rec2.record(
+            "acquire", time.monotonic(), trace=batch_root("unit-x"),
+            batch="unit-x",
+        )
+        rec2.journal_close()
+        assert journal.stat().st_size < agg._journal_offsets[str(journal)]
+
+        agg.poll_once()
+        spans = agg.stitched()["spans"]
+        batches = {
+            s.get("batch") for s in spans if s["stage"] == "acquire"
+        }
+        assert "unit-x" in batches, batches
+    finally:
+        agg.close()
+
+
+def test_poll_collects_profiles_and_console_renders_hot_stacks():
+    """--profiles: each poll also scrapes /profile per up-target; the
+    console appends the top-5 hottest-stacks panel, and a 503 (plane
+    off) renders as "profiling off", never as a scrape error."""
+    from fishnet_tpu.telemetry import profiler
+    from fishnet_tpu.telemetry.fleet import render_console
+
+    e0 = _proc_exporter(1)
+    agg = FleetAggregator(targets={"PROC0": e0.url}, profiles=True)
+    try:
+        agg.poll_once()
+        assert agg.fleet_doc()["procs"]["PROC0"]["up"] is True
+        frame = render_console(agg, profiles=True)
+        assert "HOT STACKS" in frame
+        assert "profiling off" in frame
+
+        prof = profiler.start(hz=200)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and prof.samples < 5:
+            time.sleep(0.02)
+        agg.poll_once()
+        frame = render_console(agg, profiles=True)
+        assert "samples @" in frame
+        assert "profiling off" not in frame
+        # Without the flag the panel never renders.
+        assert "HOT STACKS" not in render_console(agg)
+    finally:
+        profiler.stop()
+        agg.close()
+        e0.close()

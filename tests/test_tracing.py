@@ -362,7 +362,7 @@ def test_trace_export_cli_roundtrip(tmp_path, capsys):
 
 
 class _StubCoalescer:
-    def _execute(self, tickets):
+    def _execute(self, tickets, defer_cost=False):
         for tk in tickets:
             tk.done.set()
 
