@@ -4,11 +4,17 @@
 
 PYTHON ?= python
 
-.PHONY: analysis sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-obs-smoke mcts-smoke profile-smoke regress-smoke
+.PHONY: analysis analysis-fixtures sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-obs-smoke mcts-smoke profile-smoke regress-smoke
 
-# Project-invariant static checker (R1-R4); exit 0 = clean tree.
+# Project-invariant static checker (R1-R9); exit 0 = clean tree. The
+# JSON artifact feeds the CI annotation step (build.yml "analysis").
 analysis:
-	$(PYTHON) -m fishnet_tpu.analysis
+	$(PYTHON) -m fishnet_tpu.analysis --json analysis-findings.json
+
+# Prove every rule still fires on its violation fixtures (a rule that
+# goes blind keeps the tree green while drift accumulates).
+analysis-fixtures:
+	$(PYTHON) tools/check_fixtures.py
 
 # Telemetry contract (doc/observability.md): start the exporter on an
 # ephemeral port, scrape /metrics, validate exposition syntax and the

@@ -3,7 +3,7 @@
 The reference fishnet ships zero tests and keeps its contracts in
 comments ("don't hold it wrong"); this package makes the contracts that
 actually bit us machine-checked.  It is an AST-based rule engine with
-four project-specific rules:
+nine project-specific rules:
 
 * **R1 async-blocking** — no blocking calls (``time.sleep``,
   ``subprocess.run``, sync ``requests``/``socket`` I/O,
@@ -22,10 +22,27 @@ four project-specific rules:
 * **R4 cross-thread-state** — heuristic detection of instance/module
   state mutated both from a driver thread and from asyncio/event-loop
   methods without a lock or queue.
+* **R5 swallowed-exception** — no silent ``except`` bodies on the
+  dispatch/telemetry paths.
+* **R6 lock-order** (``locks.py``) — static lock-acquisition graph over
+  the whole serving plane: deadlock cycles, non-reentrant re-acquires,
+  and anything that reaches the metrics SCRAPE lock while holding a
+  project lock. The canonical order lives in doc/static-analysis.md.
+* **R7 telemetry-contract** (``contracts.py``) — every ``fishnet_*``
+  metric family and span stage emitted in code appears in
+  doc/observability.md with matching labels, and vice versa.
+* **R8 escape-hatch-registry** (``contracts.py``) — every ``FISHNET_*``
+  env read and every CLI/ini knob is declared in ``registry.py`` with
+  live ``documented_in``/``tested_by`` pointers, and vice versa.
+* **R9 donation-safety** (``donation.py``) — no use-after-donation of
+  arrays passed at ``donate_argnums`` positions of a jitted callable.
 
-Run ``python -m fishnet_tpu.analysis`` (exit 0 = clean); see
-``doc/static-analysis.md`` for rationale, worked examples, and the
-inline suppression syntax (``# fishnet: ignore[R2] -- justification``).
+Run ``python -m fishnet_tpu.analysis`` (exit 0 = clean); ``--json`` /
+``--sarif`` emit the structured payloads CI ingests.  See
+``doc/static-analysis.md`` for rationale, worked examples, the
+suppression lifecycle (``# fishnet: ignore[R2] -- justification``;
+comments that stop matching become ``SUP`` findings), and the canonical
+lock-order table.
 """
 
 from fishnet_tpu.analysis.engine import (  # noqa: F401
@@ -33,5 +50,7 @@ from fishnet_tpu.analysis.engine import (  # noqa: F401
     Project,
     check_paths,
     iter_python_files,
+    to_json,
+    to_sarif,
 )
 from fishnet_tpu.analysis.rules import ALL_RULES  # noqa: F401

@@ -2,22 +2,34 @@
 
 With no paths, checks the installed ``fishnet_tpu`` package tree.
 Exit status: 0 = clean, 1 = findings, 2 = usage error.
+
+``--json``/``--sarif`` write machine-readable findings to a file (or
+``-`` for stdout) for the CI annotation step and code-scanning upload;
+the human rendering and exit code are unchanged by either.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
-from fishnet_tpu.analysis.engine import check_paths
+from fishnet_tpu.analysis.engine import check_paths, to_json, to_sarif
 from fishnet_tpu.analysis.rules import ALL_RULES
+
+
+def _write(payload: str, dest: str) -> None:
+    if dest == "-":
+        sys.stdout.write(payload + "\n")
+    else:
+        Path(dest).write_text(payload + "\n", encoding="utf-8")
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m fishnet_tpu.analysis",
-        description="fishnet-tpu project-invariant static checker (R1-R4)",
+        description="fishnet-tpu project-invariant static checker (R1-R9)",
     )
     parser.add_argument(
         "paths",
@@ -30,6 +42,16 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
+    )
+    parser.add_argument(
+        "--json",
+        metavar="FILE",
+        help="write findings as a JSON array to FILE ('-' for stdout)",
+    )
+    parser.add_argument(
+        "--sarif",
+        metavar="FILE",
+        help="write findings as SARIF 2.1.0 to FILE ('-' for stdout)",
     )
     parser.add_argument(
         "-q", "--quiet", action="store_true", help="print only the summary line"
@@ -47,7 +69,12 @@ def main(argv=None) -> int:
         rules = [r for r in ALL_RULES if r.id in wanted]
         unknown = wanted - {r.id for r in ALL_RULES}
         if unknown:
-            print(f"unknown rule ids: {', '.join(sorted(unknown))}", file=sys.stderr)
+            known = ", ".join(r.id for r in ALL_RULES)
+            print(
+                f"unknown rule ids: {', '.join(sorted(unknown))}"
+                f" (known rules: {known})",
+                file=sys.stderr,
+            )
             return 2
 
     if args.paths:
@@ -63,6 +90,10 @@ def main(argv=None) -> int:
         paths = [Path(__file__).resolve().parent.parent]
 
     findings = check_paths(paths, rules)
+    if args.json:
+        _write(json.dumps(to_json(findings), indent=2), args.json)
+    if args.sarif:
+        _write(json.dumps(to_sarif(findings, rules), indent=2), args.sarif)
     if not args.quiet:
         for f in findings:
             print(f.render())

@@ -1,0 +1,303 @@
+"""R9: use-after-donation of ``donate_argnums``-donated arrays.
+
+``jax.jit(f, donate_argnums=...)`` hands the donated argument's buffer
+to XLA: after the call the caller-side array is DELETED, and touching
+it raises ``RuntimeError: Array has been deleted`` — but only at run
+time, only on backends that honor donation, and only on the code path
+that actually reaches the stale read. The wire-format layer leans on
+donation hard (ping-pong anchor tables, trainer state), so a refactor
+that inserts a read between a donating dispatch and its rebind is
+exactly the kind of bug that survives CPU-backend tests and detonates
+on the TPU. R9 makes the discipline static:
+
+1. **Wrapper discovery** — a donating callable is
+
+   * a module-level ``NAME = jax.jit(fn, donate_argnums=...)``
+     (``jax_eval.evaluate_packed_anchored_jit``),
+   * a ``self._attr = jax.jit(self._method, donate_argnums=...)``
+     bound in a method (``Trainer._step_jit``; the jitted callable
+     wraps a BOUND method, so donated indices map straight onto call
+     arguments with no ``self`` offset), or
+   * a function decorated ``@functools.partial(jax.jit,
+     donate_argnums=...)``.
+
+2. **Call-site check** — at every call of a donating wrapper, each
+   donated positional argument that is a plain name or a plain
+   ``self.x`` attribute must be REBOUND (assigned, including the
+   classic same-statement ``state = step(state, ...)``) before any
+   later load in the function. A load first is a finding.
+
+Only plain names and plain self-attributes are tracked — donated
+subscripts like ``self._tabs[g]`` are the per-group ping-pong chains
+whose rebind discipline is enforced dynamically by the eval chain (and
+suppressed R4 sites document it); flagging them here would re-litigate
+that contract with worse precision. Statement order is program-text
+order, with one path fact honored: a donating call inside ``return``/
+``raise`` ends its path, so text after it is a different branch. A
+loop back-edge that re-reads a donated name ABOVE the call is out of
+scope (documented limitation, same as R4's).
+
+Like every rule here: purely syntactic, never imports analyzed code.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from fishnet_tpu.analysis.engine import Finding, FuncInfo, Module, Project
+from fishnet_tpu.analysis.rules import _walk_own_body
+
+#: call heads that produce a donating wrapper when given donate_argnums.
+_JIT_HEADS = ("jit",)  # matched against the LAST dotted segment
+
+
+def _is_jit_call(call: ast.Call, mod: Module, imports: Dict[str, str]) -> bool:
+    proj = Project()
+    dotted = proj.resolve_dotted(call.func, imports or mod.imports)
+    if dotted is None:
+        return False
+    return dotted.rpartition(".")[2] in _JIT_HEADS
+
+
+def _donated_indices(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """donate_argnums as a tuple of ints, or None when absent/opaque."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for elt in v.elts:
+                if not (isinstance(elt, ast.Constant) and isinstance(elt.value, int)):
+                    return None
+            return tuple(elt.value for elt in v.elts)
+        return None
+    return None
+
+
+def _partial_jit_decorator(dec: ast.AST, mod: Module) -> Optional[Tuple[int, ...]]:
+    """``@functools.partial(jax.jit, donate_argnums=...)`` -> indices."""
+    if not (isinstance(dec, ast.Call) and dec.args):
+        return None
+    proj = Project()
+    head = proj.resolve_dotted(dec.func, mod.imports)
+    if head is None or head.rpartition(".")[2] != "partial":
+        return None
+    inner = dec.args[0]
+    inner_dotted = proj.resolve_dotted(inner, mod.imports)
+    if inner_dotted is None or inner_dotted.rpartition(".")[2] not in _JIT_HEADS:
+        return None
+    return _donated_indices(dec)
+
+
+@dataclass(frozen=True)
+class _Wrapper:
+    """One donating callable and where it lives."""
+
+    donated: Tuple[int, ...]
+    line: int
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """Stable key for the argument shapes we track: ``name`` or
+    ``self.attr``. Anything else (subscripts, calls, chains) -> None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return "self." + node.attr
+    return None
+
+
+class DonationSafetyRule:
+    id = "R9"
+    name = "donation-safety"
+    description = (
+        "an array passed at a donate_argnums position is deleted by the "
+        "call; it must be rebound before any later use"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules.values():
+            # wrappers addressable as module-level names, per module
+            mod_wrappers: Dict[str, _Wrapper] = {}
+            # wrappers addressable as self.<attr>, per class
+            attr_wrappers: Dict[str, Dict[str, _Wrapper]] = {}
+            self._collect_wrappers(mod, mod_wrappers, attr_wrappers)
+            # Donating names imported from sibling modules resolve too:
+            # "from ..nnue.jax_eval import evaluate_packed_anchored_jit".
+            for alias, dotted in mod.imports.items():
+                src_mod, _, src_name = dotted.rpartition(".")
+                src = project.modules.get(src_mod)
+                if src is None or alias in mod_wrappers:
+                    continue
+                w = self._module_wrapper_in(src, src_name)
+                if w is not None:
+                    mod_wrappers[alias] = w
+            if not mod_wrappers and not attr_wrappers:
+                continue
+            for info in mod.functions.values():
+                yield from self._check_function(
+                    mod, info, mod_wrappers, attr_wrappers
+                )
+
+    # -- wrapper discovery ------------------------------------------------
+
+    def _collect_wrappers(
+        self,
+        mod: Module,
+        mod_wrappers: Dict[str, _Wrapper],
+        attr_wrappers: Dict[str, Dict[str, _Wrapper]],
+    ) -> None:
+        for stmt in mod.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)
+                and _is_jit_call(stmt.value, mod, mod.imports)
+            ):
+                donated = _donated_indices(stmt.value)
+                if donated:
+                    mod_wrappers[stmt.targets[0].id] = _Wrapper(
+                        donated, stmt.lineno
+                    )
+        for info in mod.functions.values():
+            if isinstance(info.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in info.node.decorator_list:
+                    donated = _partial_jit_decorator(dec, mod)
+                    if donated and info.class_name is None:
+                        mod_wrappers[info.node.name] = _Wrapper(
+                            donated, info.node.lineno
+                        )
+            if info.class_name is None:
+                continue
+            for node in _walk_own_body(info.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)
+                    and _is_jit_call(node.value, mod, info.imports)
+                ):
+                    continue
+                key = _expr_key(node.targets[0])
+                if key is None or not key.startswith("self."):
+                    continue
+                donated = _donated_indices(node.value)
+                if donated:
+                    attr_wrappers.setdefault(info.class_name, {})[
+                        key[len("self.") :]
+                    ] = _Wrapper(donated, node.lineno)
+
+    def _module_wrapper_in(self, mod: Module, name: str) -> Optional[_Wrapper]:
+        for stmt in mod.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == name
+                and isinstance(stmt.value, ast.Call)
+                and _is_jit_call(stmt.value, mod, mod.imports)
+            ):
+                donated = _donated_indices(stmt.value)
+                if donated:
+                    return _Wrapper(donated, stmt.lineno)
+        return None
+
+    # -- call-site check --------------------------------------------------
+
+    def _check_function(
+        self,
+        mod: Module,
+        info: FuncInfo,
+        mod_wrappers: Dict[str, _Wrapper],
+        attr_wrappers: Dict[str, Dict[str, _Wrapper]],
+    ) -> Iterator[Finding]:
+        class_attrs = attr_wrappers.get(info.class_name or "", {})
+        # A call syntactically inside a `return`/`raise` ends its path:
+        # any later load in the function is on a DIFFERENT branch (the
+        # two-branch `return self._step_jit(state, batch)` mesh/no-mesh
+        # shape in the trainers), so those calls are exempt.
+        terminal_calls = set()
+        for node in _walk_own_body(info.node):
+            if isinstance(node, (ast.Return, ast.Raise)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        terminal_calls.add(id(sub))
+        # One linear pass collecting every donating call and every
+        # load/store of a tracked key, all in source order.
+        calls: List[Tuple[int, str, str]] = []  # (line, arg key, callee)
+        loads: List[Tuple[int, int, str]] = []  # (line, col, key)
+        stores: List[Tuple[int, str]] = []  # (line, key)
+        for node in _walk_own_body(info.node):
+            if isinstance(node, ast.Call) and id(node) not in terminal_calls:
+                w = self._wrapper_of(node.func, mod_wrappers, class_attrs)
+                if w is not None:
+                    wrapper, callee = w
+                    for idx in wrapper.donated:
+                        if idx >= len(node.args):
+                            continue
+                        key = _expr_key(node.args[idx])
+                        if key is not None:
+                            calls.append((node.lineno, key, callee))
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                key = _expr_key(node)
+                if key is None:
+                    continue
+                if isinstance(node.ctx, ast.Store):
+                    stores.append((node.lineno, key))
+                elif isinstance(node.ctx, ast.Load):
+                    loads.append((node.lineno, node.col_offset, key))
+        for call_line, key, callee in calls:
+            rebind = min(
+                (ln for ln, k in stores if k == key and ln >= call_line),
+                default=None,
+            )
+            for ln, col, k in loads:
+                if k != key or ln <= call_line:
+                    continue
+                if rebind is not None and rebind <= ln:
+                    break  # rebound first — the chain is ping-ponged
+                yield Finding(
+                    rule=self.id,
+                    path=str(mod.path),
+                    line=ln,
+                    col=col,
+                    message=(
+                        f"`{key}` was donated to `{callee}` on line "
+                        f"{call_line} (donate_argnums) and read again "
+                        "before being rebound; the buffer is deleted "
+                        "after the call"
+                    ),
+                    suggestion=(
+                        "rebind the name from the call's result (ping-"
+                        "pong) before any further use, or drop it from "
+                        "donate_argnums"
+                    ),
+                )
+                break  # one finding per donated arg per call
+        return
+
+    def _wrapper_of(
+        self,
+        func: ast.AST,
+        mod_wrappers: Dict[str, _Wrapper],
+        class_attrs: Dict[str, _Wrapper],
+    ) -> Optional[Tuple[_Wrapper, str]]:
+        if isinstance(func, ast.Name) and func.id in mod_wrappers:
+            return mod_wrappers[func.id], func.id
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and func.attr in class_attrs
+        ):
+            return class_attrs[func.attr], "self." + func.attr
+        return None

@@ -254,6 +254,10 @@ def _suppressions(lines: List[str]) -> Dict[int, Tuple[set, Optional[str], int]]
         m = _SUPPRESS_RE.search(text)
         if not m:
             continue
+        if m.start() > 0 and text[m.start() - 1] == "`":
+            # Backtick-quoted = documentation of the syntax (docstrings,
+            # hint strings), not a live directive.
+            continue
         rules = {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
         just = (m.group(2) or "").strip() or None
         target = i
@@ -264,7 +268,15 @@ def _suppressions(lines: List[str]) -> Dict[int, Tuple[set, Optional[str], int]]
     return out
 
 
-def apply_suppressions(findings: List[Finding], mod: Module) -> List[Finding]:
+def apply_suppressions(
+    findings: List[Finding], mod: Module, used: Optional[set] = None
+) -> List[Finding]:
+    """Filter ``findings`` through the module's inline suppressions.
+
+    ``used`` (optional) collects the comment lines of every suppression
+    that matched at least one finding — the input to stale-suppression
+    detection (a `# fishnet: ignore[Rn]` that matches nothing no longer
+    earns its place in the source and is itself reported)."""
     sup = _suppressions(mod.source_lines)
     out: List[Finding] = []
     for f in findings:
@@ -276,6 +288,8 @@ def apply_suppressions(findings: List[Finding], mod: Module) -> List[Finding]:
         if f.rule not in rules and "ALL" not in rules:
             out.append(f)
             continue
+        if used is not None:
+            used.add(comment_line)
         if just is None:
             out.append(
                 Finding(
@@ -290,6 +304,45 @@ def apply_suppressions(findings: List[Finding], mod: Module) -> List[Finding]:
                 )
             )
         # Justified: drop the finding.
+    return out
+
+
+def stale_suppressions(
+    mod: Module, used: set, ran_rule_ids: set, all_rule_ids: set
+) -> List[Finding]:
+    """Suppression comments that matched no finding this run.
+
+    A suppression is only judged stale when every rule it names actually
+    ran (an `ignore[R4]` is not stale under a `--rules R1` run), and an
+    `ignore[ALL]` only when the full rule set ran."""
+    out: List[Finding] = []
+    for _target, (rules, _just, comment_line) in _suppressions(
+        mod.source_lines
+    ).items():
+        if comment_line in used:
+            continue
+        named = rules - {"ALL"}
+        if "ALL" in rules:
+            if not all_rule_ids <= ran_rule_ids:
+                continue
+        elif not (named and named <= ran_rule_ids):
+            continue
+        out.append(
+            Finding(
+                rule="SUP",
+                path=str(mod.path),
+                line=comment_line,
+                col=0,
+                message=(
+                    "stale suppression: `# fishnet: ignore["
+                    + ",".join(sorted(rules))
+                    + "]` matches no finding — the code it excused has "
+                    "moved or been fixed"
+                ),
+                suggestion="delete the comment (or re-point it at the "
+                "line that still needs it)",
+            )
+        )
     return out
 
 
@@ -310,7 +363,10 @@ def iter_python_files(paths: Iterable[Path]) -> List[Path]:
 def check_paths(
     paths: Iterable[Path], rules: Optional[Sequence] = None
 ) -> List[Finding]:
-    """Index every file, run every rule, apply suppressions."""
+    """Index every file, run every rule, apply suppressions (tracking
+    which ones earned their keep — the rest are reported stale), and
+    return findings deterministically sorted by (path, line, col, rule)
+    so CI diffs are stable run to run."""
     from fishnet_tpu.analysis.rules import ALL_RULES
 
     rules = list(rules if rules is not None else ALL_RULES)
@@ -329,14 +385,105 @@ def check_paths(
                     message=f"file does not parse: {err.err.msg}",
                 )
             )
+    # Run every rule FIRST, then apply suppressions once per module over
+    # the combined findings: staleness is a cross-rule property (a
+    # comment is only dead when NO rule it names fires through it).
+    per_module: Dict[str, List[Finding]] = {}
     for rule in rules:
-        per_module: Dict[str, List[Finding]] = {}
         for f in rule.check(project):
             per_module.setdefault(f.path, []).append(f)
-        for mod in project.modules.values():
-            mod_findings = per_module.pop(str(mod.path), [])
-            findings.extend(apply_suppressions(mod_findings, mod))
-        for leftovers in per_module.values():  # paths not indexed (rare)
-            findings.extend(leftovers)
+    ran_ids = {rule.id for rule in rules}
+    all_ids = {rule.id for rule in ALL_RULES}
+    for mod in project.modules.values():
+        mod_findings = per_module.pop(str(mod.path), [])
+        used: set = set()
+        findings.extend(apply_suppressions(mod_findings, mod, used))
+        findings.extend(stale_suppressions(mod, used, ran_ids, all_ids))
+    for leftovers in per_module.values():  # paths not indexed (docs, rare)
+        findings.extend(leftovers)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+# -- structured output ----------------------------------------------------
+
+
+def to_json(findings: Sequence[Finding]) -> List[Dict]:
+    """Findings as JSON-ready dicts (the `--json` CLI payload and the
+    input to the CI annotation step)."""
+    return [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "col": f.col,
+            "message": f.message,
+            "suggestion": f.suggestion,
+        }
+        for f in findings
+    ]
+
+
+def to_sarif(
+    findings: Sequence[Finding], rules: Optional[Sequence] = None
+) -> Dict:
+    """Findings as a SARIF 2.1.0 log (one run, one driver) — the format
+    GitHub code scanning and most CI annotators ingest natively."""
+    descriptors = [
+        {"id": rule.id, "name": getattr(rule, "name", rule.id)}
+        for rule in (rules or [])
+    ]
+    known = {d["id"] for d in descriptors}
+    for extra in ("SUP", "AST"):
+        if extra not in known and any(f.rule == extra for f in findings):
+            descriptors.append(
+                {
+                    "id": extra,
+                    "name": "suppression-hygiene" if extra == "SUP"
+                    else "parse-error",
+                }
+            )
+    results = []
+    for f in findings:
+        text = f.message if not f.suggestion else (
+            f"{f.message} (hint: {f.suggestion})"
+        )
+        results.append(
+            {
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": text},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {
+                                "startLine": f.line,
+                                "startColumn": max(1, f.col),
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "fishnet-analysis",
+                        "informationUri": (
+                            "doc/static-analysis.md"
+                        ),
+                        "rules": descriptors,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }

@@ -1,0 +1,845 @@
+"""R6: static lock-order analysis over the whole serving plane.
+
+The platform runs ~10 threads per process (driver / pack / decode /
+frontend / profiler / fleet-aggregator) sharing the coalescer, the shard
+router, the eval cache, the metrics registry, and the span rings. Every
+one of those subsystems has its own lock, and the ONLY thing keeping
+them deadlock-free is a consistent acquisition order that until now
+lived in comments ("the router's lock is a leaf, never held while
+calling out"). This module makes the order checkable:
+
+1. **Lock discovery** — every ``threading.Lock/RLock/Condition`` bound
+   to ``self.<attr>`` in a class body or to a module-level name gets a
+   stable identity (``module.Class._attr``). A ``Condition(self._lock)``
+   is an ALIAS of the lock it wraps (waking a ``with self._cond:`` is
+   the same mutex as ``with self._lock:``).
+2. **Type environment** — ``self.x = ClassName(...)`` assignments,
+   annotated constructor parameters, and module-level instances give
+   attribute chains like ``self._svc._router`` a class, so the lock an
+   expression acquires resolves across modules (the same resolution
+   spine R2/R4 use for the call graph).
+3. **Held-lock walk** — each function is walked with the lexical
+   ``with``-stack (plus ``acquire()``/``release()`` pairing); every
+   acquisition and every resolvable call is recorded with the locks
+   held at that point. Calls on attributes resolve through the type
+   environment, including overrides in subclasses (the
+   ``CoalesceBackend`` seam dispatches into both ``SearchService`` and
+   ``AzDispatchPlane``).
+4. **Graph** — transitive acquisition closures turn "call m while
+   holding L" into edges L -> every lock m can take. Findings: cycles
+   (potential deadlock), re-acquisition of a non-reentrant lock, and
+   functions that reach the metrics-registry SCRAPE lock while holding
+   any other lock. The scrape lock is special: ``collect()`` holds it
+   across every registered collector callback, and those callbacks take
+   project locks — so the scrape lock sits at the TOP of the canonical
+   order, and acquiring it underneath anything else (an
+   ``unregister_collector`` in a close path that still holds a service
+   lock — the PR 13 exporter race family) inverts the order.
+
+Thread entry points (``Thread(target=...)`` resolutions) are collected
+so tests can assert the call graph actually follows the cross-thread
+handoffs (driver -> coalescer -> pack worker -> backend dispatch).
+
+Like every rule here: purely syntactic, never imports analyzed code.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from fishnet_tpu.analysis.engine import Finding, FuncInfo, Module, Project
+from fishnet_tpu.analysis.rules import JitHostSyncRule, _walk_own_body
+
+#: threading factories that create a mutex we track. asyncio.Lock is
+#: deliberately absent: it lives on one event loop and cannot deadlock
+#: against OS threads the way these can.
+_LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+}
+
+#: Lock ids whose attribute name matches this suffix are scrape locks —
+#: held across collector callbacks by MetricsRegistry.collect().
+_SCRAPE_SUFFIX = "_scrape_lock"
+
+_R2 = JitHostSyncRule()  # reuse the call-graph resolution spine
+
+
+@dataclass(frozen=True)
+class Site:
+    path: str
+    line: int
+    col: int
+    func: str  # qualname of the function containing the event
+    detail: str = ""
+
+
+@dataclass
+class _Event:
+    kind: str  # "acquire" | "call"
+    line: int
+    col: int
+    lock: Optional[str] = None  # acquire
+    callee: Optional[FuncInfo] = None  # call
+    held: Tuple[str, ...] = ()
+
+
+@dataclass
+class LockGraph:
+    """The static lock-acquisition graph R6 checks and the doc table is
+    generated from."""
+
+    #: lock id -> kind ("Lock" / "RLock" / "Condition")
+    locks: Dict[str, str] = field(default_factory=dict)
+    #: (outer, inner) -> example site where inner is taken under outer
+    edges: Dict[Tuple[str, str], Site] = field(default_factory=dict)
+    #: FuncInfo -> description ("Thread target in <qualname>")
+    entry_points: Dict[FuncInfo, str] = field(default_factory=dict)
+    #: resolvable static call edges (virtual dispatch included)
+    callees: Dict[FuncInfo, Set[FuncInfo]] = field(default_factory=dict)
+    #: transitive lock-acquisition closure per function
+    acquires: Dict[FuncInfo, Set[str]] = field(default_factory=dict)
+    #: collector callbacks registered via register_collector(...)
+    collectors: Set[FuncInfo] = field(default_factory=set)
+    #: the scrape lock id in effect (None when no registry is in scope)
+    scrape_lock: Optional[str] = None
+
+    def reachable_from(self, func: FuncInfo) -> Set[FuncInfo]:
+        seen: Set[FuncInfo] = set()
+        stack = [func]
+        while stack:
+            fn = stack.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            stack.extend(self.callees.get(fn, ()))
+        return seen
+
+
+class _Analyzer:
+    def __init__(self, project: Project):
+        self.project = project
+        # class key = "module.Class"
+        self.class_defs: Dict[str, ast.ClassDef] = {}
+        self.class_mod: Dict[str, Module] = {}
+        self.bases: Dict[str, List[str]] = {}
+        self.subclasses: Dict[str, List[str]] = {}
+        self.class_locks: Dict[str, Dict[str, str]] = {}  # key -> attr -> id
+        self.lock_kinds: Dict[str, str] = {}
+        self.attr_types: Dict[str, Dict[str, str]] = {}  # key -> attr -> key
+        self.module_locks: Dict[str, Dict[str, str]] = {}  # mod -> name -> id
+        self.global_types: Dict[str, str] = {}  # "mod.NAME" -> class key
+        self.events: Dict[FuncInfo, List[_Event]] = {}
+        self.graph = LockGraph()
+
+    # -- pass 1: classes, locks, types ------------------------------------
+
+    def index(self) -> None:
+        for mod in self.project.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    key = f"{mod.name}.{node.name}"
+                    self.class_defs[key] = node
+                    self.class_mod[key] = mod
+            for stmt in mod.tree.body:
+                self._module_level_assign(mod, stmt)
+        for key, node in self.class_defs.items():
+            mod = self.class_mod[key]
+            resolved = []
+            for base in node.bases:
+                bk = self._class_key_of_expr(base, mod, mod.imports)
+                if bk is not None:
+                    resolved.append(bk)
+                    self.subclasses.setdefault(bk, []).append(key)
+            self.bases[key] = resolved
+        for key in self.class_defs:
+            self._index_class(key)
+
+    def _module_level_assign(self, mod: Module, stmt: ast.stmt) -> None:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            return
+        target = stmt.targets[0]
+        if not isinstance(target, ast.Name) or not isinstance(
+            stmt.value, ast.Call
+        ):
+            return
+        dotted = self.project.resolve_dotted(stmt.value.func, mod.imports)
+        if dotted in _LOCK_FACTORIES:
+            lock_id = f"{mod.name}.{target.id}"
+            self.module_locks.setdefault(mod.name, {})[target.id] = lock_id
+            self.lock_kinds[lock_id] = _LOCK_FACTORIES[dotted]
+            return
+        key = self._class_key_of_dotted(dotted, mod)
+        if key is not None:
+            self.global_types[f"{mod.name}.{target.id}"] = key
+
+    def _class_key_of_expr(
+        self, node: ast.AST, mod: Module, imports: Dict[str, str]
+    ) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # String annotation: "CoalesceBackend".
+            dotted = node.value.strip()
+        else:
+            dotted = self.project.resolve_dotted(node, imports)
+        if dotted is None:
+            return None
+        return self._class_key_of_dotted(dotted, mod)
+
+    def _class_key_of_dotted(
+        self, dotted: Optional[str], mod: Module
+    ) -> Optional[str]:
+        if not dotted:
+            return None
+        if "." not in dotted:
+            if dotted in mod.classes:
+                return f"{mod.name}.{dotted}"
+            return None
+        mod_name, _, cls = dotted.rpartition(".")
+        owner = self.project.modules.get(mod_name)
+        if owner is not None and cls in owner.classes:
+            return f"{mod_name}.{cls}"
+        # Re-export hop: `from .registry import MetricsRegistry` in a
+        # package __init__ the caller imported through.
+        if owner is not None and cls in owner.imports:
+            return self._class_key_of_dotted(owner.imports[cls], owner)
+        return None
+
+    def _index_class(self, key: str) -> None:
+        mod = self.class_mod[key]
+        cls = key.rpartition(".")[2]
+        locks: Dict[str, str] = {}
+        types: Dict[str, str] = {}
+        cond_aliases: List[Tuple[str, str]] = []  # (attr, wrapped attr)
+        for qual in mod.classes.get(cls, {}).values():
+            info = mod.functions.get(qual)
+            if info is None:
+                continue
+            params = self._param_types(info, mod)
+            for node in _walk_own_body(info.node):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                target = node.targets[0]
+                attr = _self_plain_attr(target)
+                if attr is None:
+                    continue
+                value = node.value
+                if isinstance(value, ast.Call):
+                    dotted = self.project.resolve_dotted(
+                        value.func, info.imports
+                    )
+                    if dotted in _LOCK_FACTORIES:
+                        kind = _LOCK_FACTORIES[dotted]
+                        if kind == "Condition" and value.args:
+                            wrapped = _self_plain_attr(value.args[0])
+                            if wrapped is not None:
+                                cond_aliases.append((attr, wrapped))
+                                continue
+                        lock_id = f"{key}.{attr}"
+                        locks[attr] = lock_id
+                        self.lock_kinds[lock_id] = kind
+                        continue
+                    ck = self._class_key_of_dotted(dotted, mod)
+                    if ck is not None:
+                        types.setdefault(attr, ck)
+                        continue
+                if isinstance(value, ast.Name) and value.id in params:
+                    types.setdefault(attr, params[value.id])
+        for attr, wrapped in cond_aliases:
+            if wrapped in locks:
+                locks[attr] = locks[wrapped]  # alias: same mutex
+            else:
+                lock_id = f"{key}.{attr}"
+                locks[attr] = lock_id
+                self.lock_kinds[lock_id] = "Condition"
+        self.class_locks[key] = locks
+        self.attr_types[key] = types
+
+    def _param_types(self, info: FuncInfo, mod: Module) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        args = getattr(info.node, "args", None)
+        if args is None:
+            return out
+        for a in list(args.posonlyargs) + list(args.args) + list(
+            args.kwonlyargs
+        ):
+            if a.annotation is not None:
+                ck = self._class_key_of_expr(a.annotation, mod, info.imports)
+                if ck is not None:
+                    out[a.arg] = ck
+        return out
+
+    # -- lookup with inheritance ------------------------------------------
+
+    def _lookup(
+        self, table: Dict[str, Dict[str, str]], key: str, attr: str
+    ) -> Optional[str]:
+        seen: Set[str] = set()
+        stack = [key]
+        while stack:  # the class itself, then bases
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            hit = table.get(k, {}).get(attr)
+            if hit is not None:
+                return hit
+            stack.extend(self.bases.get(k, ()))
+        # Subclass fallback: annotations name the seam (CoalesceBackend)
+        # while the state lives on the implementation (SearchService).
+        for sub in sorted(self._all_subclasses(key)):
+            hit = table.get(sub, {}).get(attr)
+            if hit is not None:
+                return hit
+        return None
+
+    def _all_subclasses(self, key: str) -> Set[str]:
+        out: Set[str] = set()
+        stack = list(self.subclasses.get(key, ()))
+        while stack:
+            k = stack.pop()
+            if k in out:
+                continue
+            out.add(k)
+            stack.extend(self.subclasses.get(k, ()))
+        return out
+
+    def _lock_of_attr(self, key: str, attr: str) -> Optional[str]:
+        return self._lookup(self.class_locks, key, attr)
+
+    def _type_of_attr(self, key: str, attr: str) -> Optional[str]:
+        return self._lookup(self.attr_types, key, attr)
+
+    # -- expression typing -------------------------------------------------
+
+    def _object_type(
+        self, node: ast.AST, info: FuncInfo, env: Dict[str, str]
+    ) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            if node.id == "self" and info.class_name is not None:
+                return f"{info.module.name}.{info.class_name}"
+            if node.id in env:
+                return env[node.id]
+            dotted = self.project.resolve_dotted(node, info.imports)
+            return self._global_type(dotted)
+        if isinstance(node, ast.Attribute):
+            base = self._object_type(node.value, info, env)
+            if base is not None:
+                return self._type_of_attr(base, node.attr)
+            dotted = self.project.resolve_dotted(node, info.imports)
+            return self._global_type(dotted)
+        return None
+
+    def _global_type(self, dotted: Optional[str]) -> Optional[str]:
+        for _ in range(5):  # follow re-export hops with bounded fuel
+            if not dotted or "." not in dotted:
+                return None
+            if dotted in self.global_types:
+                return self.global_types[dotted]
+            mod_name, _, name = dotted.rpartition(".")
+            owner = self.project.modules.get(mod_name)
+            if owner is None or name not in owner.imports:
+                return None
+            dotted = owner.imports[name]
+        return None
+
+    def _resolve_lock(
+        self, node: ast.AST, info: FuncInfo, env: Dict[str, str],
+        lock_env: Dict[str, str],
+    ) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            if node.id in lock_env:
+                return lock_env[node.id]
+            dotted = self.project.resolve_dotted(node, info.imports)
+            return self._module_lock(dotted, info.module)
+        if isinstance(node, ast.Attribute):
+            base = self._object_type(node.value, info, env)
+            if base is not None:
+                return self._lock_of_attr(base, node.attr)
+            dotted = self.project.resolve_dotted(node, info.imports)
+            return self._module_lock(dotted, info.module)
+        return None
+
+    def _module_lock(
+        self, dotted: Optional[str], mod: Module
+    ) -> Optional[str]:
+        if not dotted:
+            return None
+        if "." not in dotted:
+            return self.module_locks.get(mod.name, {}).get(dotted)
+        mod_name, _, name = dotted.rpartition(".")
+        return self.module_locks.get(mod_name, {}).get(name)
+
+    # -- call resolution ---------------------------------------------------
+
+    def _resolve_calls(
+        self, func: ast.AST, info: FuncInfo, env: Dict[str, str]
+    ) -> List[FuncInfo]:
+        """Resolve a call target to project FuncInfos, virtual dispatch
+        included: a method on a seam class resolves to the base def AND
+        every subclass override."""
+        out: List[FuncInfo] = []
+        if isinstance(func, ast.Attribute):
+            base = self._object_type(func.value, info, env)
+            if base is not None:
+                for key in [base] + sorted(self._all_subclasses(base)):
+                    fn = self._method(key, func.attr)
+                    if fn is not None and fn not in out:
+                        out.append(fn)
+                if out:
+                    return out
+        fn = _R2._resolve_func_ref(self.project, info.module, info, func)
+        if fn is not None:
+            out.append(fn)
+        return out
+
+    def _method(self, key: str, name: str) -> Optional[FuncInfo]:
+        mod = self.class_mod.get(key)
+        if mod is None:
+            return None
+        cls = key.rpartition(".")[2]
+        seen: Set[str] = set()
+        stack = [key]
+        while stack:  # own method, then inherited defs
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            kmod = self.class_mod.get(k)
+            if kmod is not None:
+                qual = kmod.classes.get(k.rpartition(".")[2], {}).get(name)
+                if qual is not None:
+                    return kmod.functions.get(qual)
+            stack.extend(self.bases.get(k, ()))
+        del cls, mod
+        return None
+
+    # -- pass 2: per-function events ---------------------------------------
+
+    def collect_events(self) -> None:
+        for mod in self.project.modules.values():
+            for info in mod.functions.values():
+                self.events[info] = self._function_events(info)
+
+    def _function_events(self, info: FuncInfo) -> List[_Event]:
+        env = self._param_types(info, info.module)
+        lock_env: Dict[str, str] = {}
+        # Forward pre-pass: local aliases (`co = self._coalescer`,
+        # `lk = self._lock`) and acquire()/release() line ranges.
+        manual: List[Tuple[str, int, int]] = []
+        pending: Dict[str, int] = {}
+        end_line = getattr(info.node, "end_lineno", 10**9)
+        for node in _walk_own_body(info.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    lk = self._resolve_lock(node.value, info, env, lock_env)
+                    if lk is not None:
+                        lock_env.setdefault(t.id, lk)
+                    ty = self._object_type(node.value, info, env)
+                    if ty is not None:
+                        env.setdefault(t.id, ty)
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if node.func.attr in ("acquire", "release"):
+                    lk = self._resolve_lock(
+                        node.func.value, info, env, lock_env
+                    )
+                    if lk is None:
+                        continue
+                    if node.func.attr == "acquire":
+                        pending.setdefault(lk, node.lineno)
+                    elif lk in pending:
+                        manual.append((lk, pending.pop(lk), node.lineno))
+        for lk, start in pending.items():
+            manual.append((lk, start, end_line))
+
+        events: List[_Event] = []
+
+        def held_at(line: int, lexical: Tuple[str, ...]) -> Tuple[str, ...]:
+            extra = tuple(
+                lk for lk, lo, hi in manual
+                if lo < line <= hi and lk not in lexical
+            )
+            return lexical + extra
+
+        def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in node.items:
+                    expr = item.context_expr
+                    lk = self._resolve_lock(expr, info, env, lock_env)
+                    if lk is not None:
+                        events.append(
+                            _Event(
+                                "acquire", expr.lineno, expr.col_offset,
+                                lock=lk, held=held_at(expr.lineno, inner),
+                            )
+                        )
+                        inner = inner + (lk,)
+                    else:
+                        walk_expr(expr, inner)
+                for child in node.body:
+                    walk(child, inner)
+                return
+            if isinstance(node, ast.Call):
+                walk_call(node, held)
+                for child in ast.iter_child_nodes(node):
+                    walk(child, held)
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        def walk_expr(node: ast.AST, held: Tuple[str, ...]) -> None:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    walk_call(sub, held)
+
+        def walk_call(node: ast.Call, held: Tuple[str, ...]) -> None:
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "acquire":
+                    lk = self._resolve_lock(
+                        node.func.value, info, env, lock_env
+                    )
+                    if lk is not None:
+                        events.append(
+                            _Event(
+                                "acquire", node.lineno, node.col_offset,
+                                lock=lk, held=held_at(node.lineno, held),
+                            )
+                        )
+                        return
+                elif node.func.attr in (
+                    "release", "wait", "notify", "notify_all", "locked",
+                ):
+                    if self._resolve_lock(
+                        node.func.value, info, env, lock_env
+                    ) is not None:
+                        return  # operations on the lock itself: no edge
+            for callee in self._resolve_calls(node.func, info, env):
+                events.append(
+                    _Event(
+                        "call", node.lineno, node.col_offset,
+                        callee=callee, held=held_at(node.lineno, held),
+                    )
+                )
+
+        for child in ast.iter_child_nodes(info.node):
+            walk(child, ())
+        return events
+
+    # -- pass 3: closures, entry points, collectors ------------------------
+
+    def build_graph(self) -> LockGraph:
+        graph = self.graph
+        graph.locks = dict(self.lock_kinds)
+        scrape_ids = sorted(
+            lk for lk in self.lock_kinds if lk.endswith(_SCRAPE_SUFFIX)
+        )
+        graph.scrape_lock = scrape_ids[0] if scrape_ids else None
+        # Static call edges + direct acquisitions.
+        direct: Dict[FuncInfo, Set[str]] = {}
+        for info, events in self.events.items():
+            callees = graph.callees.setdefault(info, set())
+            for ev in events:
+                if ev.kind == "call" and ev.callee is not None:
+                    callees.add(ev.callee)
+                elif ev.kind == "acquire" and ev.lock is not None:
+                    direct.setdefault(info, set()).add(ev.lock)
+        # Transitive acquisition closure (fixpoint; graph may be cyclic).
+        acq: Dict[FuncInfo, Set[str]] = {
+            info: set(direct.get(info, ())) for info in self.events
+        }
+        changed = True
+        while changed:
+            changed = False
+            for info in self.events:
+                mine = acq[info]
+                before = len(mine)
+                for callee in graph.callees.get(info, ()):
+                    mine |= acq.get(callee, set())
+                if len(mine) != before:
+                    changed = True
+        graph.acquires = acq
+        # Thread entry points.
+        for mod in self.project.modules.values():
+            for info in mod.functions.values():
+                for node in _walk_own_body(info.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    dotted = (
+                        self.project.resolve_dotted(node.func, info.imports)
+                        or ""
+                    )
+                    if dotted.endswith("Thread"):
+                        for kw in node.keywords:
+                            if kw.arg != "target":
+                                continue
+                            for fn in self._resolve_calls(
+                                kw.value, info, {}
+                            ):
+                                graph.entry_points.setdefault(
+                                    fn,
+                                    f"Thread target in `{info.qualname}` "
+                                    f"({mod.name})",
+                                )
+        # Collector callbacks: collect() holds the scrape lock while
+        # calling them, so each one contributes scrape -> its closure.
+        for mod in self.project.modules.values():
+            for info in mod.functions.values():
+                for node in _walk_own_body(info.node):
+                    if not (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "register_collector"
+                        and node.args
+                    ):
+                        continue
+                    for fn in self._resolve_calls(node.args[0], info, {}):
+                        graph.collectors.add(fn)
+                        if graph.scrape_lock is not None:
+                            for lk in acq.get(fn, ()):
+                                graph.edges.setdefault(
+                                    (graph.scrape_lock, lk),
+                                    Site(
+                                        str(mod.path), node.lineno,
+                                        node.col_offset, info.qualname,
+                                        f"collector `{fn.qualname}` runs "
+                                        "under the scrape lock",
+                                    ),
+                                )
+        # Nesting edges from the event streams.
+        for info, events in self.events.items():
+            path = str(info.module.path)
+            for ev in events:
+                if not ev.held:
+                    continue
+                inner: Set[str] = set()
+                detail = ""
+                if ev.kind == "acquire" and ev.lock is not None:
+                    inner = {ev.lock}
+                elif ev.kind == "call" and ev.callee is not None:
+                    inner = acq.get(ev.callee, set())
+                    detail = f"via call to `{ev.callee.qualname}`"
+                for outer in ev.held:
+                    for lk in inner:
+                        graph.edges.setdefault(
+                            (outer, lk),
+                            Site(path, ev.line, ev.col, info.qualname,
+                                 detail),
+                        )
+        return graph
+
+
+def build_lock_graph(project: Project) -> LockGraph:
+    """Public entry: the full static lock graph for ``project``."""
+    an = _Analyzer(project)
+    an.index()
+    an.collect_events()
+    return an.build_graph()
+
+
+class LockOrderRule:
+    """R6 — see module docstring. Three finding shapes: lock-order
+    cycles, re-acquisition of a non-reentrant lock, and reaching the
+    scrape lock while holding any project lock."""
+
+    id = "R6"
+    name = "lock-order"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        an = _Analyzer(project)
+        an.index()
+        an.collect_events()
+        graph = an.build_graph()
+        yield from self._cycles(graph)
+        yield from self._reacquire(graph)
+        yield from self._scrape_under_lock(an, graph)
+
+    # -- cycles ------------------------------------------------------------
+
+    def _cycles(self, graph: LockGraph) -> Iterator[Finding]:
+        adj: Dict[str, Set[str]] = {}
+        for (a, b), _site in graph.edges.items():
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+        for scc in _sccs(adj):
+            if len(scc) < 2:
+                continue
+            cyc = sorted(scc)
+            sites = sorted(
+                (
+                    (pair, site)
+                    for pair, site in graph.edges.items()
+                    if pair[0] in scc and pair[1] in scc and pair[0] != pair[1]
+                ),
+                key=lambda kv: (kv[1].path, kv[1].line),
+            )
+            pair, site = sites[0]
+            chain = "; ".join(
+                f"`{a}` -> `{b}` at {s.path}:{s.line}"
+                + (f" ({s.detail})" if s.detail else "")
+                for (a, b), s in sites
+            )
+            yield Finding(
+                rule=self.id,
+                path=site.path,
+                line=site.line,
+                col=site.col,
+                message=(
+                    "lock-order cycle (potential deadlock) between "
+                    + ", ".join(f"`{c}`" for c in cyc)
+                    + f": {chain}"
+                ),
+                suggestion=(
+                    "pick one canonical order (doc/static-analysis.md "
+                    "lock-order table) and release the outer lock before "
+                    "calling into the other subsystem"
+                ),
+            )
+
+    # -- re-acquisition ----------------------------------------------------
+
+    def _reacquire(self, graph: LockGraph) -> Iterator[Finding]:
+        for (a, b), site in sorted(
+            graph.edges.items(), key=lambda kv: (kv[1].path, kv[1].line)
+        ):
+            if a != b or graph.locks.get(a) == "RLock":
+                continue
+            yield Finding(
+                rule=self.id,
+                path=site.path,
+                line=site.line,
+                col=site.col,
+                message=(
+                    f"`{a}` is re-acquired while already held"
+                    + (f" ({site.detail})" if site.detail else "")
+                    + " — threading.Lock is not reentrant; this "
+                    "self-deadlocks on first execution"
+                ),
+                suggestion=(
+                    "hoist the inner acquisition to the caller (the "
+                    "`_locked` suffix convention) or make the lock an "
+                    "RLock if re-entry is genuinely intended"
+                ),
+            )
+
+    # -- scrape lock -------------------------------------------------------
+
+    def _scrape_under_lock(
+        self, an: _Analyzer, graph: LockGraph
+    ) -> Iterator[Finding]:
+        scrape = graph.scrape_lock
+        if scrape is None:
+            return
+        out: List[Finding] = []
+        for info, events in an.events.items():
+            path = str(info.module.path)
+            for ev in events:
+                held = [h for h in ev.held if h != scrape]
+                if not held:
+                    continue
+                hits = False
+                what = ""
+                if ev.kind == "acquire" and ev.lock == scrape:
+                    hits, what = True, "acquires the scrape lock"
+                elif ev.kind == "call" and ev.callee is not None:
+                    if scrape in graph.acquires.get(ev.callee, ()):
+                        hits = True
+                        what = (
+                            f"calls `{ev.callee.qualname}`, which acquires "
+                            "the scrape lock"
+                        )
+                if hits:
+                    out.append(
+                        Finding(
+                            rule=self.id,
+                            path=path,
+                            line=ev.line,
+                            col=ev.col,
+                            message=(
+                                f"`{info.qualname}` {what} while holding "
+                                + ", ".join(f"`{h}`" for h in held)
+                                + " — collect() holds the scrape lock "
+                                "across collector callbacks that take "
+                                "project locks, so this inverts the "
+                                "canonical order (deadlock against a "
+                                "concurrent scrape)"
+                            ),
+                            suggestion=(
+                                "release every project lock before "
+                                "(un)registering collectors or forcing a "
+                                "scrape barrier — the close paths do this "
+                                "by unregistering FIRST"
+                            ),
+                        )
+                    )
+        yield from sorted(out, key=lambda f: (f.path, f.line))
+
+
+def _sccs(adj: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan's strongly-connected components, iterative."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+    nodes = sorted(set(adj) | {b for bs in adj.values() for b in bs})
+
+    for root in nodes:
+        if root in index:
+            continue
+        work: List[Tuple[str, Optional[str], List[str]]] = [
+            (root, None, sorted(adj.get(root, ())))
+        ]
+        while work:
+            node, parent, todo = work.pop()
+            if node not in index:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recursed = False
+            while todo:
+                nxt = todo[0]
+                todo = todo[1:]
+                if nxt not in index:
+                    work.append((node, parent, todo))
+                    work.append((nxt, node, sorted(adj.get(nxt, ()))))
+                    recursed = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if recursed:
+                continue
+            if low[node] == index[node]:
+                scc: Set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                out.append(scc)
+            if parent is not None:
+                low[parent] = min(low[parent], low[node])
+    return out
+
+
+def _self_plain_attr(node: ast.AST) -> Optional[str]:
+    """`self.x` (no deeper chain, no subscript) -> "x"."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None

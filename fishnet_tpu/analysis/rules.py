@@ -986,10 +986,28 @@ def _walk_own_stmts(handler) -> Iterator[ast.AST]:
         stack.extend(ast.iter_child_nodes(node))
 
 
+# R6-R9 live in their own modules (lock graphs, doc/registry contracts,
+# and donation tracking each deserve a file) but build on the resolution
+# spine above, so they import THIS module. Importing them down here —
+# after every shared helper and rule class is defined — keeps the
+# one-stop ALL_RULES registry without an import cycle: any entry into
+# the package runs fishnet_tpu.analysis.__init__ first, which imports
+# this module before any sibling.
+from fishnet_tpu.analysis.contracts import (  # noqa: E402
+    EscapeHatchRule,
+    TelemetryContractRule,
+)
+from fishnet_tpu.analysis.donation import DonationSafetyRule  # noqa: E402
+from fishnet_tpu.analysis.locks import LockOrderRule  # noqa: E402
+
 ALL_RULES = [
     AsyncBlockingRule(),
     JitHostSyncRule(),
     DeprecatedJaxRule(),
     CrossThreadStateRule(),
     SwallowedExceptionRule(),
+    LockOrderRule(),
+    TelemetryContractRule(),
+    EscapeHatchRule(),
+    DonationSafetyRule(),
 ]

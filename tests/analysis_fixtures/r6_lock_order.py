@@ -1,0 +1,64 @@
+"""R6 fixture: lock-order violations. Line numbers are asserted by
+tests/test_analysis.py — edit with care."""
+
+import threading
+
+registry = None
+
+
+def register_collector(fn):
+    registry.append(fn)
+
+
+class Registry:
+    """A metrics registry shape: collect() holds the scrape lock across
+    every registered collector callback."""
+
+    def __init__(self):
+        self._scrape_lock = threading.Lock()
+        self._collectors = []
+
+    def collect(self):
+        with self._scrape_lock:
+            for fn in self._collectors:
+                fn()
+
+
+class Pipeline:
+    def __init__(self):
+        self._pack_lock = threading.Lock()
+        self._decode_lock = threading.Lock()
+        self._registry = Registry()
+
+    def pack(self):
+        # pack -> decode ... (cycle reported at line 36, the call site)
+        with self._pack_lock:
+            self._finish_decode()
+
+    def _finish_decode(self):
+        with self._decode_lock:
+            pass
+
+    def decode(self):
+        # ... while decode -> pack: VIOLATION (cycle)
+        with self._decode_lock:
+            self._repack()
+
+    def _repack(self):
+        with self._pack_lock:
+            pass
+
+    def close(self):
+        # VIOLATION: reaches the scrape lock while holding _pack_lock
+        # (the exporter-close inversion family), line 55
+        with self._pack_lock:
+            self._registry.collect()
+
+    def stats(self):
+        # VIOLATION: re-acquire of a non-reentrant lock, line 60
+        with self._pack_lock:
+            self._sum()
+
+    def _sum(self):
+        with self._pack_lock:
+            return 0

@@ -1,0 +1,23 @@
+"""R7 fixture: telemetry-contract drift against r7_observability.md.
+Line numbers are asserted by tests/test_analysis.py — edit with care."""
+
+REGISTRY = None
+_SPANS = None
+
+
+def serve(n):
+    # Documented family with matching labels: fine.
+    REGISTRY.counter(
+        "fishnet_fixture_requests_total", "requests", labelnames=("code",)
+    ).inc()
+    # VIOLATION line 14: emitted but not mentioned in the doc.
+    REGISTRY.gauge("fishnet_fixture_depth", "queue depth").set(n)
+    # VIOLATION line 16: documented labels are {code}; code says {code, tenant}.
+    REGISTRY.counter(
+        "fishnet_fixture_errors_total",
+        "errors",
+        labelnames=("code", "tenant"),
+    ).inc()
+    # VIOLATION line 22: span stage never documented in a Stage table.
+    with _SPANS.record("fixture_pack"):
+        pass

@@ -1,0 +1,24 @@
+"""R8 fixture: escape hatches missing from the knob registry. The test
+harness runs EscapeHatchRule with an explicit declared-knob list that
+covers only FISHNET_FIXTURE_DECLARED and --fixture-declared. Line
+numbers are asserted by tests/test_analysis.py — edit with care."""
+
+import os
+
+DECLARED = os.environ.get("FISHNET_FIXTURE_DECLARED")  # declared: fine
+
+# VIOLATION line 11: env read with no registry row.
+UNDECLARED = os.environ.get("FISHNET_FIXTURE_UNDECLARED", "0")
+
+# VIOLATION line 14: name-constant env read with no registry row.
+ROGUE_ENV = "FISHNET_FIXTURE_ROGUE"
+
+
+def hatch():
+    return os.environ.get(ROGUE_ENV)
+
+
+def build_parser(parser):
+    parser.add_argument("--fixture-declared", type=int)  # declared: fine
+    # VIOLATION line 24: CLI option with no registry row.
+    parser.add_argument("--fixture-undeclared", action="store_true")

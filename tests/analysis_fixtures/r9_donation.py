@@ -1,0 +1,50 @@
+"""R9 fixture: use-after-donation of donate_argnums buffers. Line
+numbers are asserted by tests/test_analysis.py — edit with care."""
+
+import functools
+
+import jax
+
+
+def _step(state, batch):
+    return state
+
+
+step_jit = jax.jit(_step, donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def fwd(params, buf):
+    return buf
+
+
+def train_bad(state, batch):
+    out = step_jit(state, batch)
+    return state.params, out  # VIOLATION line 23: `state` donated on 22
+
+
+def train_good(state, batch):
+    state = step_jit(state, batch)  # classic ping-pong rebind: fine
+    return state.params
+
+
+def fwd_bad(params, buf):
+    out = fwd(params, buf)
+    return buf + out  # VIOLATION line 33: `buf` donated on 32
+
+
+class Runner:
+    def __init__(self):
+        self._fj = jax.jit(self._f, donate_argnums=(0,))
+        self._buf = None
+
+    def _f(self, b):
+        return b
+
+    def run_bad(self):
+        out = self._fj(self._buf)
+        return self._buf, out  # VIOLATION line 46: `self._buf` donated on 45
+
+    def run_good(self):
+        self._buf = self._fj(self._buf)  # rebind from the result: fine
+        return self._buf

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Prove every analysis fixture still fires its rule.
+
+CI runs this as ``make analysis-fixtures``: each file under
+``tests/analysis_fixtures/`` is checked with exactly the rule it
+exercises (plus the contract inputs the rule needs — the R7 fixture
+brings its own observability doc, the R8 fixture its own knob list),
+and must yield at least the pinned number of findings. A rule that
+stops firing on its own fixture has silently lost its teeth — that is
+a harder failure mode than a false positive, because the whole-tree
+run stays green while drift accumulates.
+
+Exact line-number pins live in tests/test_analysis.py; this harness is
+the cheap CI smoke that runs without pytest.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from fishnet_tpu.analysis.engine import check_paths  # noqa: E402
+from fishnet_tpu.analysis.contracts import (  # noqa: E402
+    EscapeHatchRule,
+    TelemetryContractRule,
+)
+from fishnet_tpu.analysis.donation import DonationSafetyRule  # noqa: E402
+from fishnet_tpu.analysis.locks import LockOrderRule  # noqa: E402
+from fishnet_tpu.analysis.registry import Knob  # noqa: E402
+from fishnet_tpu.analysis.rules import (  # noqa: E402
+    AsyncBlockingRule,
+    CrossThreadStateRule,
+    DeprecatedJaxRule,
+    JitHostSyncRule,
+    SwallowedExceptionRule,
+)
+
+FIXTURES = REPO / "tests" / "analysis_fixtures"
+
+#: fixture file -> (rule instance, minimum findings of that rule's id)
+MATRIX = {
+    "r1_async_blocking.py": (AsyncBlockingRule(), 5),
+    "r2_jit_host_sync.py": (JitHostSyncRule(), 8),
+    "r3_deprecated_jax.py": (DeprecatedJaxRule(), 3),
+    "r4_cross_thread.py": (CrossThreadStateRule(), 5),
+    "r5_swallowed.py": (SwallowedExceptionRule(), 3),
+    "r6_lock_order.py": (LockOrderRule(), 3),
+    "r7_telemetry_contract.py": (
+        TelemetryContractRule(doc_path=FIXTURES / "r7_observability.md"),
+        5,
+    ),
+    "r8_escape_hatch.py": (
+        EscapeHatchRule(
+            knobs=(
+                Knob("FISHNET_FIXTURE_DECLARED", "env", "unset",
+                     "doc/install.md"),
+                Knob("--fixture-declared", "cli", "unset",
+                     "doc/install.md"),
+            )
+        ),
+        3,
+    ),
+    "r9_donation.py": (DonationSafetyRule(), 3),
+}
+
+
+def main() -> int:
+    failed = False
+    for fname, (rule, floor) in sorted(MATRIX.items()):
+        path = FIXTURES / fname
+        if not path.exists():
+            print(f"FAIL {fname}: fixture file missing")
+            failed = True
+            continue
+        findings = [
+            f for f in check_paths([path], [rule]) if f.rule == rule.id
+        ]
+        ok = len(findings) >= floor
+        status = "ok  " if ok else "FAIL"
+        print(
+            f"{status} {rule.id} {fname}: {len(findings)} finding(s)"
+            f" (floor {floor})"
+        )
+        if not ok:
+            failed = True
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
