@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis analysis-fixtures sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-obs-smoke mcts-smoke profile-smoke regress-smoke
+.PHONY: analysis analysis-fixtures sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-cache-smoke fleet-obs-smoke mcts-smoke profile-smoke regress-smoke
 
 # Project-invariant static checker (R1-R9); exit 0 = clean tree. The
 # JSON artifact feeds the CI annotation step (build.yml "analysis").
@@ -103,6 +103,16 @@ mcts-smoke:
 cluster-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cluster.py -q \
 		-k "smoke or drain"
+
+# Fleet position-tier contract (doc/eval-cache.md "Fleet tier",
+# ≤45 s subset of tests/test_position_tier.py): exact NNUE/AZ slot
+# round-trips through the mmap'd segment, graceful fallback with the
+# tier disabled or the segment absent, and the two-process smoke — a
+# second real service process resolves another process's evals from
+# the shared segment pre-wire with bit-identical analyses.
+fleet-cache-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_position_tier.py -q \
+		-k "two_process or roundtrip or fallback"
 
 # Fleet observability contract (doc/observability.md "Fleet
 # observability", ≤45 s): metrics federation with proc labels and

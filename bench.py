@@ -775,6 +775,22 @@ SUMMARY_SCHEMA = {
         "procs", "stale_probe", "slo", "stitch", "critical_path",
         "perfetto",
     ),
+    # --fleet-cache mode (keyed by mode == "fleet_cache"): the fleet-
+    # wide position tier (ISSUE 17) — a 3-process supervisor fleet of
+    # REAL tpu-nnue clients replays one overlapping opening-heavy job
+    # set tier-off then tier-on, with one SIGKILL mid-replay in the
+    # tier-on phase. Headline: fraction of shared-tier probes resolved
+    # from a slot another process wrote, gated alongside nodes/eval vs
+    # the BENCH_r06 baseline, tier on/off analysis parity, and the
+    # exactly-once fleet ledger (doc/eval-cache.md "Fleet tier").
+    "fleet_cache": (
+        "metric", "value", "unit", "mode", "nodes", "processes",
+        "workload", "off", "on", "parity", "gates", "ledger",
+    ),
+    "fleet_cache.phase": (
+        "tier", "seconds", "jobs", "nodes_total", "evals_shipped",
+        "nodes_per_eval", "postier", "chaos", "ledger", "drain",
+    ),
     # Continuous-profiler section, embedded by EVERY mode (ISSUE 15):
     # where the run's milliseconds went, not just how much it did —
     # top folded stacks by sample count and per-stage duration
@@ -789,7 +805,7 @@ SUMMARY_SCHEMA = {
 
 #: Every mode's summary carries the profiler section (validated below).
 for _mode_key in ("top", "overload", "multichip", "cache_replay",
-                  "mcts", "cluster"):
+                  "mcts", "cluster", "fleet_cache"):
     SUMMARY_SCHEMA[_mode_key] = SUMMARY_SCHEMA[_mode_key] + ("profile",)
 
 
@@ -867,6 +883,20 @@ def validate_summary(summary: dict) -> None:
             missing += [
                 f"{ph}.{k}"
                 for k in SUMMARY_SCHEMA["mcts.phase"] if k not in sub
+            ]
+        if missing:
+            raise ValueError(f"bench summary missing keys: {missing}")
+        return
+    if summary.get("mode") == "fleet_cache":
+        missing = [
+            k for k in SUMMARY_SCHEMA["fleet_cache"] if k not in summary
+        ]
+        for ph in ("off", "on"):
+            sub = summary.get(ph, {})
+            missing += [
+                f"{ph}.{k}"
+                for k in SUMMARY_SCHEMA["fleet_cache.phase"]
+                if k not in sub
             ]
         if missing:
             raise ValueError(f"bench summary missing keys: {missing}")
@@ -1455,6 +1485,475 @@ def run_cluster_bench(
                     "jobs_synthesized": li.refill_count,
                 },
             }
+
+    return asyncio.run(drive())
+
+
+#: Fleet-cache-mode knobs (env overridable; FLEETCACHE_r01). The
+#: workload is opening-heavy BY DESIGN: every opening line is queued
+#: FLEETCACHE_COPIES times and the server hands copies to whichever
+#: process asks first, so most lines are searched by a process that
+#: never saw them — but whose fleet-mates already paid for every eval
+#: and published it into the shared position tier (doc/eval-cache.md
+#: "Fleet tier").
+FLEETCACHE_PROCS = int(_os.environ.get("FISHNET_FLEETCACHE_PROCS", 3))
+#: 280 nodes/search matches BENCH_r06's cache-replay runs, so the
+#: nodes-per-eval gate below compares like for like.
+FLEETCACHE_NODES = int(_os.environ.get("FISHNET_FLEETCACHE_NODES", 280))
+FLEETCACHE_OPENINGS = int(_os.environ.get("FISHNET_FLEETCACHE_OPENINGS", 8))
+FLEETCACHE_COPIES = int(_os.environ.get("FISHNET_FLEETCACHE_COPIES", 4))
+FLEETCACHE_PLY = int(_os.environ.get("FISHNET_FLEETCACHE_PLY", 6))
+#: Supervisor monitor tick (0.25 s) on which the one SIGKILL fires:
+#: tick 48 is ~12 s in — after the children's JAX warmup, well before
+#: the replay drains — so the kill lands mid-replay with slots
+#: mid-write (the seqlock/reclaim path under real traffic).
+FLEETCACHE_KILL_TICK = int(
+    _os.environ.get("FISHNET_FLEETCACHE_KILL_TICK", 48)
+)
+FLEETCACHE_DEADLINE_S = float(
+    _os.environ.get("FISHNET_FLEETCACHE_DEADLINE", 600.0)
+)
+#: Acceptance gates (ISSUE 17): at least 30% of shared-tier probes must
+#: resolve from a slot ANOTHER process wrote, and the tier-on fleet's
+#: nodes-per-shipped-eval must beat the BENCH_r06 single-process
+#: baseline (1.67) — cross-process hits must show up as real dispatch
+#: work avoided, not just cache-counter noise.
+FLEETCACHE_HIT_RATE_GATE = float(
+    _os.environ.get("FISHNET_FLEETCACHE_HIT_RATE_GATE", 0.3)
+)
+FLEETCACHE_NODES_PER_EVAL_GATE = 1.67
+
+
+def run_fleet_cache_bench(
+    procs: int = FLEETCACHE_PROCS,
+    nodes: int = FLEETCACHE_NODES,
+) -> dict:
+    """Fleet-wide position-tier benchmark (ISSUE 17): ``procs`` real
+    ``python -m fishnet_tpu`` client processes — REAL tpu-nnue engines
+    on material weights, not mocks — replay one overlapping
+    opening-heavy job set against one fake server, twice:
+
+    * ``off`` — ``FISHNET_POSITION_TIER=0``: every process keeps only
+      its private eval cache; copies of a line landing on different
+      processes pay the device for every eval again.
+    * ``on``  — the HEADLINE: all processes attach one mmap'd segment,
+      probe it pre-wire in the cache seam, and feed cross-process hits
+      through ``fc_pool_tt_fill``. One seeded SIGKILL lands mid-replay
+      (slot writes in flight), the supervisor restarts the child, and
+      the server-side fleet ledger must still audit exactly-once.
+
+    Gates: cross-process hit rate >= FLEETCACHE_HIT_RATE_GATE of tier
+    probes, tier-on nodes/eval > FLEETCACHE_NODES_PER_EVAL_GATE
+    (BENCH_r06 baseline), and tier on/off analyses bit-identical.
+
+    The parity gate is a CONTROLLED probe, not a diff of the two fleet
+    runs: which process wins each acquire is a race, and a long-lived
+    process's persistent TT means a job's reported depth/nodes depend
+    on what that process searched before — two fleet replays diverge
+    even with the tier off everywhere. So parity replays the job set
+    in THIS process in one fixed order, twice — tier off, then tier on
+    over the very segment the fleet just wrote (cold local cache, same
+    net fingerprint) — and requires every analysis field bit-identical
+    while fleet-written slots are actually being served (fleet-scope
+    hits > 0). That is the tier's whole correctness claim: an eval some
+    other process paid for substitutes bit-exactly."""
+    import glob as _glob
+    import random
+    import tempfile
+    import urllib.request
+
+    from fishnet_tpu.chess import Board
+    from fishnet_tpu.cluster import position_tier
+    from fishnet_tpu.cluster.supervisor import FleetSupervisor, ProcSpec
+    from fishnet_tpu.resilience.soak import _load_fake_server
+    from fishnet_tpu.utils.logger import Logger
+
+    fake = _load_fake_server()
+    startpos = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+    # Deterministic opening lines: seeded playouts from startpos, one
+    # rng per opening, so every run (and both phases) queues byte-equal
+    # work. Copies of one line are the cross-process overlap the tier
+    # exists to exploit.
+    lines = []
+    for o in range(FLEETCACHE_OPENINGS):
+        rng = random.Random(f"fleetcache-{o}")
+        while True:
+            board = Board(startpos)
+            moves = []
+            while len(moves) < FLEETCACHE_PLY and board.outcome() == 0:
+                moves.append(rng.choice(board.legal_moves()))
+                board.push_uci(moves[-1])
+            if len(moves) == FLEETCACHE_PLY:
+                break
+        lines.append(moves)
+    jobs = [
+        (f"FLC{o:02d}c{c}", lines[o])
+        for o in range(FLEETCACHE_OPENINGS)
+        for c in range(FLEETCACHE_COPIES)
+    ]
+
+    tmpdir = tempfile.mkdtemp(prefix="fishnet-fleetcache-")
+    nnue_path = _os.path.join(tmpdir, "material.npz")
+    material_weights().save(nnue_path)
+
+    def _parse_prom(text: str) -> dict:
+        out = {}
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            lhs, _, val = line.rpartition(" ")
+            if "{" in lhs:
+                name, _, rest = lhs.partition("{")
+                labels = tuple(sorted(
+                    p for p in rest.rstrip("}").split(",") if p
+                ))
+            else:
+                name, labels = lhs, ()
+            try:
+                out[(name, labels)] = float(val)
+            except ValueError:
+                continue
+        return out
+
+    class _RestartSafeCounters:
+        """Accumulates exporter counters across process incarnations: a
+        series going BACKWARDS means the child restarted (fresh process,
+        counters from zero), so the dead incarnation's last-seen value
+        is banked before following the new one. The SIGKILL scenario
+        depends on this — the killed child's pre-kill work must not
+        vanish from the fleet totals."""
+
+        WANTED = frozenset((
+            "fishnet_postier_hits_total", "fishnet_postier_misses_total",
+            "fishnet_postier_evictions_total", "fishnet_pool_nodes_total",
+            "fishnet_pool_evals_shipped_total",
+        ))
+
+        def __init__(self):
+            self._base = {}
+            self._last = {}
+
+        def poll(self, workdir: str) -> None:
+            for path in _glob.glob(_os.path.join(workdir, "*.port")):
+                proc = _os.path.splitext(_os.path.basename(path))[0]
+                try:
+                    port = int(open(path, encoding="utf-8").read().strip())
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=2.0
+                    ) as resp:
+                        text = resp.read().decode()
+                except (OSError, ValueError):
+                    continue  # mid-write port file or mid-restart child
+                for (name, labels), val in _parse_prom(text).items():
+                    if name not in self.WANTED:
+                        continue
+                    k = (proc, name, labels)
+                    prev = self._last.get(k, 0.0)
+                    if val < prev:
+                        self._base[k] = self._base.get(k, 0.0) + prev
+                    self._last[k] = val
+
+        def total(self, name: str, **labels) -> int:
+            want = {f'{k}="{v}"' for k, v in labels.items()}
+            tot = 0.0
+            for (proc, n, lbls), last in self._last.items():
+                if n == name and want <= set(lbls):
+                    tot += last + self._base.get((proc, n, lbls), 0.0)
+            return int(round(tot))
+
+    async def phase(tier_on: bool) -> dict:
+        lichess = fake.FakeLichess(require_key=False)
+        lichess.reassign_after = 2.0
+        for wid, moves in jobs:
+            lichess.add_analysis_job(
+                moves=" ".join(moves), position=startpos, nodes=nodes,
+                work_id=wid,
+            )
+        tier_env = {
+            "FISHNET_POSITION_TIER": "1" if tier_on else "0",
+            "FISHNET_POSITION_TIER_PATH": _os.path.join(
+                tmpdir, "postier.seg"
+            ),
+        }
+        saved = {k: _os.environ.get(k) for k in tier_env}
+        _os.environ.update(tier_env)
+        try:
+            if tier_on:
+                # Pre-create the segment from the parent so no child can
+                # glimpse a half-written header mid-create and silently
+                # fall back to process-local reuse.
+                position_tier.reset_tier()
+                seg = position_tier.get_tier()
+                if seg is None:
+                    raise AssertionError("parent could not create tier")
+                position_tier.reset_tier()
+            specs = [
+                ProcSpec(
+                    name=f"PROC{i}",
+                    fault_spec=(
+                        f"seed=29;proc.kill:nth={FLEETCACHE_KILL_TICK}:crash"
+                        if tier_on and i == 1 else ""
+                    ),
+                    # Appended last, so these override the supervisor's
+                    # default `--engine mock`: the children run the real
+                    # searcher on the shared material net (one file ->
+                    # one net_fingerprint -> one tier keyspace).
+                    extra_args=(
+                        "--engine", "tpu-nnue", "--nnue-file", nnue_path,
+                    ),
+                )
+                for i in range(procs)
+            ]
+            async with fake.FakeServer(lichess) as server:
+                supervisor = FleetSupervisor(
+                    server.endpoint,
+                    specs,
+                    logger=Logger(verbose=0),
+                    tick_seconds=0.25,
+                )
+                await supervisor.start()
+                tracker = _RestartSafeCounters()
+                try:
+                    t0 = time.monotonic()
+                    killed = not tier_on
+                    while time.monotonic() - t0 < FLEETCACHE_DEADLINE_S:
+                        await asyncio.sleep(0.5)
+                        await asyncio.to_thread(
+                            tracker.poll, str(supervisor.workdir)
+                        )
+                        kinds = [k for _, _, k in supervisor.events]
+                        killed = killed or "kill" in kinds
+                        if killed and len(lichess.analyses) >= len(jobs):
+                            break
+                    else:
+                        raise AssertionError(
+                            f"fleet-cache phase timed out: "
+                            f"{len(lichess.analyses)}/{len(jobs)} analyses "
+                            f"after {FLEETCACHE_DEADLINE_S}s "
+                            f"(logs under {supervisor.workdir})"
+                        )
+                    # Final pre-drain scrape: children are idle-polling
+                    # by now, so every counter is at its terminal value.
+                    await asyncio.to_thread(
+                        tracker.poll, str(supervisor.workdir)
+                    )
+                    exit_codes = await supervisor.drain()
+                except BaseException:
+                    await supervisor.kill_all()
+                    raise
+                measured = round(time.monotonic() - t0, 2)
+                fleet = lichess.fleet_report()
+                kinds = [k for _, _, k in supervisor.events]
+                if not fleet["clean"]:
+                    raise AssertionError(f"fleet ledger dirty: {fleet}")
+                if len(lichess.analyses) != len(jobs):
+                    raise AssertionError(
+                        f"{len(lichess.analyses)}/{len(jobs)} jobs analysed"
+                    )
+                bad = {n: rc for n, rc in exit_codes.items() if rc != 0}
+                if bad:
+                    raise AssertionError(
+                        f"fleet drain exited nonzero: {bad} "
+                        f"(logs under {supervisor.workdir})"
+                    )
+                if tier_on and kinds.count("kill") < 1:
+                    raise AssertionError(
+                        f"no SIGKILL fired mid-replay: {kinds}"
+                    )
+                hits_fleet = tracker.total(
+                    "fishnet_postier_hits_total", scope="fleet",
+                    family="nnue",
+                )
+                hits_local = tracker.total(
+                    "fishnet_postier_hits_total", scope="local",
+                    family="nnue",
+                )
+                misses = tracker.total(
+                    "fishnet_postier_misses_total", family="nnue"
+                )
+                probes = hits_fleet + hits_local + misses
+                nodes_total = tracker.total("fishnet_pool_nodes_total")
+                evals = tracker.total("fishnet_pool_evals_shipped_total")
+                log(
+                    f"bench: fleet-cache tier-"
+                    f"{'on' if tier_on else 'off'} phase done in "
+                    f"{measured}s — {nodes_total} nodes / {evals} evals "
+                    f"shipped = {round(nodes_total / max(1, evals), 3)} "
+                    f"nodes/eval; tier probes {probes} "
+                    f"(fleet {hits_fleet}, local {hits_local}, "
+                    f"miss {misses})"
+                )
+                return {
+                    "tier": "on" if tier_on else "off",
+                    "seconds": measured,
+                    "jobs": len(jobs),
+                    "nodes_total": nodes_total,
+                    "evals_shipped": evals,
+                    "nodes_per_eval": round(nodes_total / max(1, evals), 3),
+                    "postier": {
+                        "fleet_hits": hits_fleet,
+                        "local_hits": hits_local,
+                        "misses": misses,
+                        "probes": probes,
+                        "cross_process_hit_rate": round(
+                            hits_fleet / max(1, probes), 4
+                        ),
+                        "evictions": tracker.total(
+                            "fishnet_postier_evictions_total", family="nnue"
+                        ),
+                        "az_fleet_hits": tracker.total(
+                            "fishnet_postier_hits_total", scope="fleet",
+                            family="az",
+                        ),
+                    },
+                    "chaos": {
+                        "kills": kinds.count("kill"),
+                        "restarts": supervisor.restarts_total(),
+                        "events": [list(e) for e in supervisor.events],
+                    },
+                    "ledger": fleet,
+                    "drain": {"exit_codes": exit_codes, "all_zero": not bad},
+                }
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    _os.environ.pop(k, None)
+                else:
+                    _os.environ[k] = v
+
+    async def parity_leg(tier_on: bool) -> tuple:
+        """One single-ordered replay of the job lines in THIS process:
+        fresh (cold) process cache, fresh tier resolution, the same
+        weights file — so the ONLY variable between the two legs is
+        whether evals resolve from the fleet-written segment."""
+        from fishnet_tpu.cluster import position_tier as _pt
+        from fishnet_tpu.nnue.weights import NnueWeights
+        from fishnet_tpu.search import eval_cache as _ec
+        from fishnet_tpu.search.service import SearchService
+
+        tier_env = {
+            "FISHNET_POSITION_TIER": "1" if tier_on else "0",
+            "FISHNET_POSITION_TIER_PATH": _os.path.join(
+                tmpdir, "postier.seg"
+            ),
+        }
+        saved = {k: _os.environ.get(k) for k in tier_env}
+        _os.environ.update(tier_env)
+        _ec.reset_cache()
+        _pt.reset_tier()
+        hits0 = _pt.stats().get("hits.fleet.nnue", 0)
+        try:
+            svc = SearchService(
+                weights=NnueWeights.load(nnue_path), net_path=nnue_path,
+                pool_slots=8, batch_capacity=256, tt_bytes=8 << 20,
+                pipeline_depth=4, driver_threads=1,
+            )
+            try:
+                svc.set_prefetch(0, adaptive=False)
+                analyses = []
+                for moves in lines:
+                    for k in range(len(moves) + 1):
+                        r = await svc.search(
+                            root_fen=startpos, moves=moves[:k],
+                            nodes=nodes, depth=0, multipv=1,
+                        )
+                        analyses.append((
+                            r.best_move, r.depth, r.nodes,
+                            tuple(
+                                (l.multipv, l.depth, l.is_mate, l.value,
+                                 tuple(l.pv))
+                                for l in r.lines
+                            ),
+                        ))
+            finally:
+                svc.close()
+            return analyses, _pt.stats().get("hits.fleet.nnue", 0) - hits0
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    _os.environ.pop(k, None)
+                else:
+                    _os.environ[k] = v
+            _ec.reset_cache()
+            _pt.reset_tier()
+
+    async def drive() -> dict:
+        log(f"bench: fleet-cache phase 1/2 — tier OFF, {len(jobs)} jobs...")
+        off = await phase(tier_on=False)
+        log(
+            f"bench: fleet-cache phase 2/2 — tier ON + SIGKILL at tick "
+            f"{FLEETCACHE_KILL_TICK}..."
+        )
+        on = await phase(tier_on=True)
+
+        rate = on["postier"]["cross_process_hit_rate"]
+        if rate < FLEETCACHE_HIT_RATE_GATE:
+            raise AssertionError(
+                f"cross-process hit rate {rate} < "
+                f"{FLEETCACHE_HIT_RATE_GATE}: {on['postier']}"
+            )
+        if on["nodes_per_eval"] <= FLEETCACHE_NODES_PER_EVAL_GATE:
+            raise AssertionError(
+                f"tier-on nodes/eval {on['nodes_per_eval']} <= "
+                f"{FLEETCACHE_NODES_PER_EVAL_GATE} (BENCH_r06 baseline)"
+            )
+
+        log(
+            "bench: parity probe — single-ordered replay, tier off vs "
+            "tier on over the fleet-written segment..."
+        )
+        analyses_off, _ = await parity_leg(tier_on=False)
+        analyses_on, probe_fleet_hits = await parity_leg(tier_on=True)
+        if probe_fleet_hits < 1:
+            raise AssertionError(
+                "parity probe served no fleet-written slots — nothing "
+                "was proven (segment evicted or fingerprint drifted?)"
+            )
+        if analyses_off != analyses_on:
+            diff = [
+                i for i, (a, b) in enumerate(zip(analyses_off, analyses_on))
+                if a != b
+            ]
+            raise AssertionError(
+                f"tier on/off analyses diverged at positions {diff[:4]} "
+                f"({len(diff)} of {len(analyses_off)}): "
+                f"off={analyses_off[diff[0]]} on={analyses_on[diff[0]]}"
+            )
+        return {
+            "metric": "fleetcache_cross_process_hit_rate",
+            "value": rate,
+            "unit": "ratio",
+            "mode": "fleet_cache",
+            "profile": profile_section(),
+            "nodes": nodes,
+            "processes": procs,
+            "workload": {
+                "openings": FLEETCACHE_OPENINGS,
+                "copies": FLEETCACHE_COPIES,
+                "ply": FLEETCACHE_PLY,
+                "jobs": len(jobs),
+                "positions_per_job": FLEETCACHE_PLY + 1,
+            },
+            "off": off,
+            "on": on,
+            "parity": {
+                "identical": True,
+                "positions_compared": len(analyses_off),
+                "probe_fleet_hits": probe_fleet_hits,
+                "method": (
+                    "single-ordered replay in one process, tier off vs "
+                    "tier on over the fleet-written segment (cold local "
+                    "cache); full analysis tuples incl. depth/nodes/pv"
+                ),
+            },
+            "gates": {
+                "cross_process_hit_rate_min": FLEETCACHE_HIT_RATE_GATE,
+                "nodes_per_eval_min": FLEETCACHE_NODES_PER_EVAL_GATE,
+                "passed": True,
+            },
+            "ledger": on["ledger"],
+        }
 
     return asyncio.run(drive())
 
@@ -2393,6 +2892,16 @@ def main(argv=None) -> None:
         "run_cache_replay_bench)",
     )
     parser.add_argument(
+        "--fleet-cache", action="store_true",
+        help="run the fleet-wide position-tier benchmark instead of the "
+        "throughput tiers: a 3-process supervisor fleet of real "
+        "tpu-nnue clients replays overlapping opening-heavy traffic "
+        "tier-off then tier-on (one SIGKILL mid-replay), gating "
+        "cross-process hit rate, nodes/eval vs BENCH_r06, tier on/off "
+        "analysis parity, and the exactly-once fleet ledger (see "
+        "run_fleet_cache_bench)",
+    )
+    parser.add_argument(
         "--mcts", action="store_true",
         help="run the shared-plane batched MCTS benchmark instead of "
         "the throughput tiers: AZ leaf traffic on the coalesced "
@@ -2421,6 +2930,17 @@ def main(argv=None) -> None:
             f"visits, {MCTS_WARM_ROUNDS} warm rounds..."
         )
         summary = run_mcts_bench()
+        emit_summary(summary, args.json_out)
+        return
+
+    if args.fleet_cache:
+        log(
+            f"bench: fleet-cache mode — {FLEETCACHE_PROCS} tpu-nnue "
+            f"client processes, {FLEETCACHE_OPENINGS}x"
+            f"{FLEETCACHE_COPIES} overlapping opening jobs, tier "
+            "off/on + SIGKILL mid-replay..."
+        )
+        summary = run_fleet_cache_bench()
         emit_summary(summary, args.json_out)
         return
 

@@ -374,11 +374,32 @@ async def run_client(opt: Opt, logger: Logger) -> None:
     net_fp = (
         eval_cache_mod.net_fingerprint(opt.nnue_file) if opt.nnue_file else 0
     )
+    # The AZ cache rides the same snapshot under its own fingerprint
+    # (az params hash, 0 for dev-mode random weights) so a restarted
+    # MCTS fleet warm-starts pre-wire too.
+    az_fp = 0
+    if opt.az_net_file:
+        try:
+            import numpy as _np
+
+            with _np.load(opt.az_net_file) as _loaded:
+                az_fp = eval_cache_mod.az_net_fingerprint(
+                    {k: _loaded[k] for k in _loaded.files}
+                )
+        except (OSError, ValueError, KeyError):
+            az_fp = 0
     if eval_cache_mod.snapshot_path() is not None:
-        if eval_cache_mod.load_snapshot(fingerprint=net_fp):
+        if eval_cache_mod.load_snapshot(
+            fingerprint=net_fp, az_fingerprint=az_fp
+        ):
             cache = eval_cache_mod.get_cache()
             n = len(cache) if cache is not None else 0
-            logger.info(f"Restored {n} eval-cache entries from snapshot.")
+            az_cache = eval_cache_mod.get_az_cache()
+            n_az = len(az_cache) if az_cache is not None else 0
+            logger.info(
+                f"Restored {n} eval-cache entries "
+                f"(+{n_az} az) from snapshot."
+            )
 
     engine_factory = build_engine_factory(opt, logger)
     shed_policy = None
@@ -518,7 +539,9 @@ async def run_client(opt: Opt, logger: Logger) -> None:
         # snapshot holds the final working set; before engine teardown
         # so a slow native close can't outlive the write.
         if eval_cache_mod.snapshot_path() is not None:
-            eval_cache_mod.save_snapshot(fingerprint=net_fp)
+            eval_cache_mod.save_snapshot(
+                fingerprint=net_fp, az_fingerprint=az_fp
+            )
         # Tear down shared engine backends before interpreter exit: a
         # daemon driver thread still inside native/JAX code when Python
         # unwinds takes the process down with SIGABRT.

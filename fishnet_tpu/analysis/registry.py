@@ -84,6 +84,15 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/search.md", "tests/test_mcts_plane.py"),
     Knob("FISHNET_NO_SUBTREE_REUSE", "env", "unset (subtree reuse on)",
          "doc/search.md"),
+    Knob("FISHNET_POSITION_TIER", "env", "unset (fleet tier off)",
+         "doc/eval-cache.md", "tests/test_position_tier.py"),
+    Knob("FISHNET_POSITION_TIER_PATH", "env",
+         "fishnet-postier-<uid>.seg in the system tempdir",
+         "doc/eval-cache.md", "tests/test_position_tier.py"),
+    Knob("FISHNET_POSITION_TIER_CAPACITY", "env", "65536 NNUE slots",
+         "doc/eval-cache.md", "tests/test_position_tier.py"),
+    Knob("FISHNET_POSITION_TIER_AZ_CAPACITY", "env", "256 AZ slots",
+         "doc/eval-cache.md", "tests/test_position_tier.py"),
     Knob("FISHNET_PROFILE", "env", "unset (profiler off)",
          "doc/observability.md", "tests/test_profiler.py"),
     Knob("FISHNET_PROFILE_HZ", "env", "29 (samples/second)",
@@ -119,6 +128,8 @@ KNOBS: Tuple[Knob, ...] = (
     Knob("--engine-exe", "cli", "bundled binary", "doc/install.md"),
     Knob("--fault-plan", "cli", "unset", "doc/resilience.md",
          "tests/test_configure.py"),
+    Knob("--fleet-cache", "cli", "off (bench.py mode flag)",
+         "doc/eval-cache.md", "tests/test_position_tier.py"),
     Knob("--key", "cli", "unset (dialog asks)", "README.md",
          "tests/test_configure.py"),
     Knob("--key-file", "cli", "unset", "doc/install.md",

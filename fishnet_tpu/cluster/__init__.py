@@ -17,9 +17,26 @@ All chaos is driven by the fault-plan grammar
 ``proxy.partition``, ``proxy.latency``, ``proxy.error5xx``,
 ``proc.kill`` and ``proc.sigterm`` — seedable, deterministic,
 documented in doc/resilience.md.
+
+The package also hosts the fleet-wide position tier
+(:mod:`fishnet_tpu.cluster.position_tier`) — imported by the search
+service in every client process — so the chaos-harness names below are
+resolved lazily: attaching the shared eval segment must not drag the
+proxy's aiohttp dependency into the serving path.
 """
 
-from fishnet_tpu.cluster.proxy import ChaosProxy
-from fishnet_tpu.cluster.supervisor import FleetSupervisor, ProcSpec
+_LAZY = {
+    "ChaosProxy": "fishnet_tpu.cluster.proxy",
+    "FleetSupervisor": "fishnet_tpu.cluster.supervisor",
+    "ProcSpec": "fishnet_tpu.cluster.supervisor",
+}
 
 __all__ = ["ChaosProxy", "FleetSupervisor", "ProcSpec"]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -865,6 +865,15 @@ class QueueStub:
             cache = eval_cache.get_cache()
             if cache is not None:
                 cache.advance_generation()
+                # The fleet tier shares the clock: any process's batch
+                # completion ages the whole segment's slots, so fixed-
+                # slot replacement prefers positions no live batch
+                # anywhere in the fleet is still visiting.
+                from fishnet_tpu.cluster import position_tier
+
+                tier = position_tier.get_tier()
+                if tier is not None:
+                    tier.advance_generation()
         if completed is None:
             if not pending.work.matrix_wanted:
                 report = pending.progress_report()

@@ -168,6 +168,18 @@ class AzDispatchPlane(CoalesceBackend):
         self._n_groups = max_lanes
         self._replicas = replicate_params(params, devs)
         self._salt = _eval_cache.az_net_fingerprint(params)
+        # FLEET POSITION TIER (doc/eval-cache.md "Fleet tier"): AZ leaf
+        # traffic rides the shared segment's AZ region under its own
+        # fingerprint salt. Probed only for rows the process AzEvalCache
+        # missed; the policy-size guard drops the tier on architecture
+        # drift rather than reading misaligned rows.
+        self._postier = None
+        if not _eval_cache.cache_disabled():
+            from fishnet_tpu.cluster import position_tier as _postier_mod
+
+            tier = _postier_mod.get_tier()
+            if tier is not None and tier.az_policy_size == POLICY_SIZE:
+                self._postier = tier
         self._router = (
             ShardRouter(max_lanes, self._n_shards)
             if self._n_shards > 1 else None
@@ -289,6 +301,28 @@ class AzDispatchPlane(CoalesceBackend):
             if hits:
                 with self._stats_lock:
                     self._prewire_hits += hits
+            # Fleet-tier probe for the rows the process cache missed
+            # (local -> fleet -> miss). A fleet hit is the exact fp16
+            # payload a sibling dispatched, so the fp32 reconstruction
+            # below is bit-identical to paying the eval here; promote
+            # it into the process cache so the next probe stays local.
+            if self._postier is not None and miss:
+                still = []
+                fleet = 0
+                for i in miss:
+                    ent = self._postier.probe_az(salted[i])
+                    if ent is None:
+                        still.append(i)
+                        continue
+                    lg16, val = ent
+                    out_logits[i] = lg16.astype(np.float32)
+                    out_values[i] = val
+                    cache.insert(salted[i], (lg16, np.float32(val)))
+                    fleet += 1
+                miss = still
+                if fleet:
+                    with self._stats_lock:
+                        self._prewire_hits += fleet
             if not miss:
                 with self._stats_lock:
                     self._skipped_dispatches += 1
@@ -331,6 +365,12 @@ class AzDispatchPlane(CoalesceBackend):
                 cache.insert(
                     salted[i], (np.asarray(lg, np.float16), val)
                 )
+                # Publish the freshly paid row fleet-wide (same exact
+                # fp16 payload the process cache stores).
+                if self._postier is not None:
+                    self._postier.insert_az(
+                        salted[i], np.asarray(lg, np.float16), float(val)
+                    )
         return out_logits, out_values
 
     # -- CoalesceBackend surface ------------------------------------------

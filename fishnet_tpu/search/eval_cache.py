@@ -391,6 +391,81 @@ class AzEvalCache(EvalCache):
             self._misses += len(out) - hits
         return out
 
+    # -- snapshot (warm restart) ------------------------------------------
+
+    def dump_az_entries(
+        self,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """All entries as ``(hashes, logits_fp16 [n, P], values_f32,
+        generations)`` arrays — the object payloads flattened into
+        dense arrays npz can round-trip exactly (the fp16 rows ARE the
+        stored bits). Rows whose policy width disagrees with the first
+        row are skipped (a cache can in principle hold mixed
+        architectures; a snapshot cannot)."""
+        hashes: List[int] = []
+        rows: List[np.ndarray] = []
+        values: List[float] = []
+        gens: List[int] = []
+        width: Optional[int] = None
+        for s in range(self._n_stripes):
+            with self._locks[s]:
+                items = list(self._stripes[s].items())
+            for h, (ent, g) in items:
+                try:
+                    lg, val = ent
+                    lg = np.asarray(lg, dtype=np.float16).reshape(-1)
+                except (TypeError, ValueError):
+                    continue
+                if width is None:
+                    width = len(lg)
+                elif len(lg) != width:
+                    continue
+                hashes.append(h)
+                rows.append(lg)
+                values.append(float(val))
+                gens.append(g)
+        logits = (
+            np.stack(rows) if rows else np.empty((0, 0), dtype=np.float16)
+        )
+        return (
+            np.array(hashes, dtype=np.uint64),
+            logits.astype(np.float16, copy=False),
+            np.array(values, dtype=np.float32),
+            np.array(gens, dtype=np.int64),
+        )
+
+    def load_az_entries(
+        self,
+        hashes: np.ndarray,
+        logits: np.ndarray,
+        values: np.ndarray,
+        gens: np.ndarray,
+    ) -> int:
+        """Restore dumped AZ entries; the inverse of
+        :meth:`dump_az_entries`. Each restored entry is the exact
+        ``(fp16 row, float32 value)`` tuple the plane would have
+        inserted, so warm-restart replays reconstruct identical fp32
+        logits. Generation clock semantics match the base loader."""
+        n = min(len(hashes), len(logits), len(values), len(gens))
+        top = 0
+        for i in range(n):
+            h = int(hashes[i])
+            g = int(gens[i])
+            top = max(top, g)
+            ent = (
+                np.array(logits[i], dtype=np.float16),
+                np.float32(values[i]),
+            )
+            s = self._stripe_of(h)
+            with self._locks[s]:
+                stripe = self._stripes[s]
+                if h not in stripe and len(stripe) >= self._stripe_cap:
+                    self._evict_locked(s)
+                stripe[h] = (ent, g)
+        with self._meta_lock:
+            self._generation = max(self._generation, top)
+        return n
+
 
 # -- process-wide singleton -----------------------------------------------
 
@@ -512,23 +587,48 @@ def reset_cache() -> None:
 
 
 def save_snapshot(
-    path: Optional[str] = None, fingerprint: int = 0
+    path: Optional[str] = None, fingerprint: int = 0,
+    az_fingerprint: int = 0,
 ) -> Optional[str]:
-    """Persist the process cache to ``path`` (default: the
+    """Persist the process caches to ``path`` (default: the
     ``FISHNET_EVAL_CACHE_SNAPSHOT`` file; None with neither = no-op).
     ``fingerprint`` is the serving net's identity
     (:func:`net_fingerprint`; 0 for dev-mode random weights) — a
     restart onto different weights must NOT read this snapshot's evals,
-    so :func:`load_snapshot` discards on mismatch. Atomic
-    (tmp + rename): a SIGKILL mid-write leaves the previous snapshot
-    intact, never a torn file. Returns the path written, or None."""
+    so :func:`load_snapshot` discards on mismatch. The AZ cache rides
+    the same file under its own ``az_fingerprint``
+    (:func:`az_net_fingerprint`), so a restarted MCTS fleet warm-starts
+    pre-wire too; either family may be empty. Atomic (tmp + rename): a
+    SIGKILL mid-write leaves the previous snapshot intact, never a torn
+    file. Returns the path written, or None."""
     path = path or snapshot_path()
     if path is None:
         return None
     cache = _global_cache
-    if cache is None:
+    az_cache = _global_az_cache
+    if cache is None and az_cache is None:
         return None
-    hashes, values, gens = cache.dump_entries()
+    if cache is not None:
+        hashes, values, gens = cache.dump_entries()
+        generation = cache.stats()["generation"]
+    else:
+        hashes = np.empty(0, np.uint64)
+        values = np.empty(0, np.int32)
+        gens = np.empty(0, np.int64)
+        generation = 0
+    arrays = {}
+    if az_cache is not None:
+        az_hashes, az_logits, az_values, az_gens = (
+            az_cache.dump_az_entries()
+        )
+        if len(az_hashes):
+            arrays = dict(
+                az_fingerprint=np.uint64(az_fingerprint & ((1 << 64) - 1)),
+                az_hashes=az_hashes,
+                az_logits=az_logits,
+                az_values=az_values,
+                az_gens=az_gens,
+            )
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         parent = os.path.dirname(path)
@@ -541,10 +641,11 @@ def save_snapshot(
                 f,
                 version=np.int64(SNAPSHOT_VERSION),
                 fingerprint=np.uint64(fingerprint & ((1 << 64) - 1)),
-                generation=np.int64(cache.stats()["generation"]),
+                generation=np.int64(generation),
                 hashes=hashes,
                 values=values,
                 gens=gens,
+                **arrays,
             )
         os.replace(tmp, path)
     except OSError:
@@ -558,13 +659,19 @@ def save_snapshot(
 
 
 def load_snapshot(
-    path: Optional[str] = None, fingerprint: int = 0
+    path: Optional[str] = None, fingerprint: int = 0,
+    az_fingerprint: int = 0,
 ) -> bool:
-    """Restore a snapshot into the process cache. Returns True when
-    entries were restored. A version or fingerprint mismatch (or a
-    corrupt file) DISCARDS the snapshot — the file is removed so a
+    """Restore a snapshot into the process caches. Returns True when
+    entries were restored. A version or NNUE fingerprint mismatch (or
+    a corrupt file) DISCARDS the snapshot — the file is removed so a
     process that upgraded its net doesn't retry the stale snapshot on
-    every restart — and returns False."""
+    every restart — and returns False. The AZ section is checked
+    against ``az_fingerprint`` independently: an AZ-only mismatch
+    skips just that section (the NNUE warm-start is still good — the
+    two nets upgrade on different cadences), and a malformed AZ
+    section never poisons the cache (the partially restored entries
+    are dropped and the file discarded)."""
     import zipfile
 
     path = path or snapshot_path()
@@ -573,6 +680,7 @@ def load_snapshot(
     cache = get_cache()
     if cache is None:
         return False
+    restored = False
     try:
         with np.load(path) as data:
             version = int(data["version"])
@@ -582,12 +690,28 @@ def load_snapshot(
             ):
                 raise ValueError("snapshot version/fingerprint mismatch")
             cache.load_entries(data["hashes"], data["values"], data["gens"])
+            restored = True
+            if "az_hashes" in data.files:
+                az_fp = int(data["az_fingerprint"])
+                if az_fp == (az_fingerprint & ((1 << 64) - 1)):
+                    az_cache = get_az_cache()
+                    if az_cache is not None:
+                        try:
+                            az_cache.load_az_entries(
+                                data["az_hashes"],
+                                data["az_logits"],
+                                data["az_values"],
+                                data["az_gens"],
+                            )
+                        except (TypeError, ValueError, KeyError):
+                            az_cache.clear()
+                            raise
     except (OSError, ValueError, KeyError, zipfile.BadZipFile):
         try:
             os.remove(path)
         except OSError:
             pass
-        return False
+        return restored
     return True
 
 

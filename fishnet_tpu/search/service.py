@@ -1748,6 +1748,18 @@ class SearchService(CoalesceBackend):
         self._hash_buf = np.empty((k, cap), dtype=np.uint64)
         self._cache_val_buf = np.empty((k, cap), dtype=np.int32)
         self._miss_hist = _eval_cache_mod.MissHistory()
+        # FLEET POSITION TIER (doc/eval-cache.md "Fleet tier"): the
+        # shared cross-process segment, probed only for rows the
+        # process cache missed (fallback ladder local -> fleet ->
+        # miss). None unless FISHNET_POSITION_TIER=1 attached a
+        # segment; keys use the same net-fingerprint salt, so tier
+        # hits feed the identical tt_fill/insert plumbing below.
+        if self._eval_cache is not None:
+            from fishnet_tpu.cluster import position_tier as _postier_mod
+
+            self._postier = _postier_mod.get_tier()
+        else:
+            self._postier = None
         # Opt-in cache-miss prefetch steering (tentpole part 4): high
         # sustained hit rates pin the speculative budget down (the
         # cache already serves those leaves for free), miss-heavy
@@ -3053,6 +3065,19 @@ class SearchService(CoalesceBackend):
                                 lib.fc_pool_tt_fill(
                                     self._pool, int(hb[i]), int(values[i])
                                 )
+                        # Fleet-tier publish: only the rows this batch
+                        # actually paid for on the device (~hmask) go
+                        # to the shared segment — pre-wire hits are
+                        # already there or live in the process cache,
+                        # and republishing hot rows every batch would
+                        # put a Python loop on the provide path for
+                        # nothing.
+                        if self._postier is not None and hmask is not None:
+                            paid = ~hmask
+                            if paid.any():
+                                self._postier.insert_nnue_block(
+                                    (hb ^ salt)[paid], values[paid]
+                                )
                     if tel:
                         _SPANS.record(
                             "wire_decode", t0,
@@ -3151,6 +3176,33 @@ class SearchService(CoalesceBackend):
                                 "cache_probe", t0c, trace=dctx,
                                 group=g, n=n, hits=hits,
                             )
+                        # FLEET TIER PROBE (doc/eval-cache.md "Fleet
+                        # tier"): rows the process cache missed get one
+                        # shot at the shared segment. Fleet hits are
+                        # merged into hmask/hvals, so downstream they
+                        # are indistinguishable from local hits — the
+                        # fused planner drops them pre-dispatch and the
+                        # provide-time fc_pool_tt_fill loop lands them
+                        # in the pool TT for move ordering. Promote
+                        # each fleet hit into the process cache so the
+                        # next probe of that position stays local.
+                        if self._postier is not None and hits < n:
+                            t0f = time.monotonic() if tel else 0.0
+                            lmask = hmask.copy()
+                            fleet_hits = self._postier.probe_nnue_block(
+                                hashes ^ salt, hvals, hmask
+                            )
+                            if tel:
+                                _SPANS.record(
+                                    "postier_probe", t0f, trace=dctx,
+                                    group=g, n=n - hits, hits=fleet_hits,
+                                )
+                            if fleet_hits:
+                                newly = hmask & ~lmask
+                                cache.insert_block(
+                                    (hashes ^ salt)[newly], hvals[newly]
+                                )
+                                hits += fleet_hits
                         self._miss_hist.record(g, hits, n)
                         if self._cache_steer:
                             self._steer_prefetch(g)

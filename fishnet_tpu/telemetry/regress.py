@@ -78,7 +78,7 @@ class Spec:
     15%). ``severity``: ``gate`` exits nonzero, ``watch`` only
     reports."""
 
-    prefix: str  # artifact family: BENCH / MULTICHIP / CLUSTER / MCTS
+    prefix: str  # artifact family: BENCH / MULTICHIP / CLUSTER / MCTS / FLEETCACHE
     metric: str  # series name within the family
     path: str
     direction: str
@@ -127,6 +127,19 @@ SERIES_SPECS: Tuple[Spec, ...] = (
     Spec("CLUSTER", "recovery_within_bound", "recovery.within_bound",
          "true", 0.0, "gate"),
     Spec("CLUSTER", "drain_all_zero", "drain.all_zero", "true", 0.0,
+         "gate"),
+    # -- FLEETCACHE (fleet-wide position tier; bench.py --fleet-cache) ---
+    Spec("FLEETCACHE", "cross_process_hit_rate", "value", "up", 0.15,
+         "gate"),
+    Spec("FLEETCACHE", "nodes_per_eval_on", "on.nodes_per_eval", "up",
+         0.15, "watch"),
+    Spec("FLEETCACHE", "parity_identical", "parity.identical", "true",
+         0.0, "gate"),
+    Spec("FLEETCACHE", "ledger_lost", "ledger.lost", "zero", 0.0,
+         "gate"),
+    Spec("FLEETCACHE", "ledger_duplicated", "ledger.duplicated", "zero",
+         0.0, "gate"),
+    Spec("FLEETCACHE", "gates_passed", "gates.passed", "true", 0.0,
          "gate"),
     # -- MCTS (shared-plane AZ bench) ------------------------------------
     Spec("MCTS", "warm_visits_per_s", "value", "up", 0.20, "gate"),
