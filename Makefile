@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis analysis-fixtures sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-cache-smoke fleet-obs-smoke mcts-smoke profile-smoke regress-smoke
+.PHONY: analysis analysis-fixtures sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-cache-smoke control-smoke fleet-obs-smoke mcts-smoke profile-smoke regress-smoke
 
 # Project-invariant static checker (R1-R9); exit 0 = clean tree. The
 # JSON artifact feeds the CI annotation step (build.yml "analysis").
@@ -113,6 +113,15 @@ cluster-smoke:
 fleet-cache-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_position_tier.py -q \
 		-k "two_process or roundtrip or fallback"
+
+# Self-tuning control plane (doc/control-plane.md, ≤60 s): signal
+# folding + hysteresis, actuator bounds/revert and the
+# FISHNET_NO_CONTROL byte-for-byte escape hatch, the deterministic
+# rule/probe decision tables, degraded-shard skip, the burn_snapshot
+# seam, the subsystem actuation seams, the fleet --control panel, and
+# a real-service end-to-end controller probe loop.
+control-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_control.py -q
 
 # Fleet observability contract (doc/observability.md "Fleet
 # observability", ≤45 s): metrics federation with proc labels and

@@ -791,6 +791,22 @@ SUMMARY_SCHEMA = {
         "tier", "seconds", "jobs", "nodes_total", "evals_shipped",
         "nodes_per_eval", "postier", "chaos", "ledger", "drain",
     ),
+    # --control mode (keyed by mode == "control"): the self-tuning
+    # control plane (ISSUE 18) A/B — the same two traffic mixes
+    # (steady concurrent analysis vs bursty short best-move waves) run
+    # under explicit static knob settings and under the controller,
+    # with analyses bit-identical across every arm, an escape-hatch
+    # phase (FISHNET_NO_CONTROL=1 => zero actuations, static results),
+    # and the exactly-once ledger (doc/control-plane.md).
+    "control": (
+        "metric", "value", "unit", "mode", "nodes", "arms", "steady",
+        "bursty", "escape_hatch", "actuations", "parity", "gates",
+        "ledger",
+    ),
+    "control.arm": (
+        "arm", "seconds", "searches_per_s", "dispatches", "eval_steps",
+        "nodes", "coalesce_width", "pipeline_depth",
+    ),
     # Continuous-profiler section, embedded by EVERY mode (ISSUE 15):
     # where the run's milliseconds went, not just how much it did —
     # top folded stacks by sample count and per-stage duration
@@ -805,7 +821,7 @@ SUMMARY_SCHEMA = {
 
 #: Every mode's summary carries the profiler section (validated below).
 for _mode_key in ("top", "overload", "multichip", "cache_replay",
-                  "mcts", "cluster", "fleet_cache"):
+                  "mcts", "cluster", "fleet_cache", "control"):
     SUMMARY_SCHEMA[_mode_key] = SUMMARY_SCHEMA[_mode_key] + ("profile",)
 
 
@@ -898,6 +914,17 @@ def validate_summary(summary: dict) -> None:
                 for k in SUMMARY_SCHEMA["fleet_cache.phase"]
                 if k not in sub
             ]
+        if missing:
+            raise ValueError(f"bench summary missing keys: {missing}")
+        return
+    if summary.get("mode") == "control":
+        missing = [k for k in SUMMARY_SCHEMA["control"] if k not in summary]
+        for mix in ("steady", "bursty"):
+            for arm, sub in (summary.get(mix, {}) or {}).items():
+                missing += [
+                    f"{mix}.{arm}.{k}"
+                    for k in SUMMARY_SCHEMA["control.arm"] if k not in sub
+                ]
         if missing:
             raise ValueError(f"bench summary missing keys: {missing}")
         return
@@ -2336,6 +2363,312 @@ def run_cache_replay_bench(nodes: int = CACHE_REPLAY_NODES) -> dict:
     }
 
 
+#: Control-plane bench knobs (overridable by env).
+CONTROL_NODES = int(_os.environ.get("FISHNET_CONTROL_NODES", 220))
+#: Fractional noise allowance on the searches/s A/B comparisons (1-core
+#: CPU timing: every arm runs identical deterministic work, so the
+#: spread is scheduler noise, not workload variance).
+CONTROL_NOISE_BAND = 0.20
+#: Runs per (mix, arm) cell; each cell reports its best run, which
+#: suppresses the one-sided shared-box slowdowns that would otherwise
+#: eat the whole gate band.
+CONTROL_REPS = int(_os.environ.get("FISHNET_CONTROL_REPS", 2))
+
+
+def run_control_bench(nodes: int = CONTROL_NODES) -> dict:
+    """Self-tuning control plane A/B (ISSUE 18): two traffic mixes run
+    under explicit static knob settings and under the live controller
+    (fishnet_tpu/control), on a real SearchService.
+
+    * ``steady`` — one big concurrent analysis wave (every search
+      queued before the service warms): sustained coalescable traffic,
+      where a too-narrow width under-amortizes the fixed dispatch cost.
+    * ``bursty`` — short best-move searches in small sequential waves:
+      interactive traffic, where a forced-wide width and deep pipeline
+      buy nothing and the static-aggressive arm pays their overhead.
+
+    Arms per mix: ``static_narrow`` (width 1, depth 1),
+    ``static_wide`` (width 8, depth 4), and ``controller`` (probe
+    defaults + the rule policy actuating live). The controller only
+    moves scheduling knobs, so every arm's analyses must be
+    bit-identical — ``parity.identical`` pins it; ``escape_hatch``
+    re-runs the controller wiring under FISHNET_NO_CONTROL=1 and pins
+    zero actuations with the same results; the exactly-once ledger
+    audits every phase."""
+    from fishnet_tpu.control import (
+        ActuatorRegistry, Controller, SignalCollector,
+    )
+    from fishnet_tpu.control.controller import (
+        shutdown_controller, standard_actuators,
+    )
+    from fishnet_tpu.resilience import accounting
+    from fishnet_tpu.search import eval_cache
+    from fishnet_tpu.search.service import SearchService
+
+    weights = material_weights()
+    steady_jobs = make_workload(10, 6, seed=44)
+    bursty_jobs = make_workload(8, 3, seed=45)
+    #: Untimed warm prologue, identical for every arm: static arms
+    #: start the clock with hot pipelines, and the controller arm does
+    #: its adapting here — the timed window then compares OPERATING
+    #: points, not convergence transients (which would otherwise poison
+    #: the probe's ref/trial comparison with the warm-up ramp).
+    prologue_jobs = make_workload(8, 3, seed=46)
+
+    class _Gated(SearchService):
+        def __init__(self, *a, **k):
+            self.gate = threading.Event()
+            super().__init__(*a, **k)
+
+        def warmup(self):
+            super().warmup()
+            self.gate.wait()
+
+    def search_one(svc, ledger, bid, fen, moves, n):
+        async def go():
+            ledger.record_acquired(bid)
+            r = await svc.search(fen, moves, nodes=n)
+            ledger.record_submitted(bid)
+            return (
+                r.best_move, r.depth, r.nodes,
+                tuple(
+                    (l.multipv, l.depth, l.is_mate, l.value, tuple(l.pv))
+                    for l in r.lines
+                ),
+            )
+        return go()
+
+    def run_prologue(svc, ledger, tag):
+        """Warm phase (untimed, parity-checked): one concurrent wave of
+        steady-shaped traffic at 150 nodes."""
+        svc.gate.set()
+
+        async def go():
+            return await asyncio.gather(*[
+                search_one(svc, ledger, f"ctl-{tag}-pro-{i}", j[0], j[1], 150)
+                for i, j in enumerate(prologue_jobs)
+            ])
+
+        return asyncio.run(go())
+
+    def run_steady(svc, ledger, tag):
+        """Everything queued, then one gated release (cache_replay's
+        deterministic-start discipline)."""
+        async def go():
+            tasks = [
+                asyncio.ensure_future(search_one(
+                    svc, ledger, f"ctl-{tag}-steady-{i}", j[0], j[1], nodes
+                ))
+                for i, j in enumerate(steady_jobs)
+            ]
+            await asyncio.sleep(0.3)  # let every submission queue
+            svc.gate.set()
+            return await asyncio.gather(*tasks)
+
+        t0 = time.perf_counter()
+        out = asyncio.run(go())
+        return out, time.perf_counter() - t0, len(steady_jobs)
+
+    def run_bursty(svc, ledger, tag):
+        """Short searches in sequential 3-wide waves — each wave fully
+        drains before the next arrives (interactive best-move shape)."""
+        svc.gate.set()  # no queue-up phase: bursts hit a live service
+        waves = [bursty_jobs[i:i + 3] for i in range(0, len(bursty_jobs), 3)]
+
+        async def go():
+            out = []
+            for w, wave in enumerate(waves):
+                out.extend(await asyncio.gather(*[
+                    search_one(
+                        svc, ledger, f"ctl-{tag}-bursty-{w}-{i}",
+                        j[0], j[1], max(40, nodes // 4),
+                    )
+                    for i, j in enumerate(wave)
+                ]))
+            return out
+
+        t0 = time.perf_counter()
+        out = asyncio.run(go())
+        return out, time.perf_counter() - t0, len(bursty_jobs)
+
+    def build_svc():
+        svc = _Gated(
+            weights=weights, pool_slots=32, batch_capacity=256,
+            tt_bytes=16 << 20, pipeline_depth=4, driver_threads=1,
+        )
+        # Same determinism discipline as cache_replay: speculative
+        # prefetch off in EVERY arm, so node counts are bit-comparable
+        # and the A/B isolates the scheduling knobs under test.
+        svc.set_prefetch(0, adaptive=False)
+        return svc
+
+    def arm_row(arm, svc, elapsed, n_searches, delta):
+        return {
+            "arm": arm,
+            "seconds": round(elapsed, 2),
+            "searches_per_s": round(n_searches / max(1e-9, elapsed), 3),
+            "dispatches": delta.get("dispatches", 0),
+            "eval_steps": delta.get("eval_steps", 0),
+            "nodes": delta.get("nodes", 0),
+            "coalesce_width": svc.coalesce_width(),
+            "pipeline_depth": svc.async_depth(),
+        }
+
+    def run_arm(arm, mix, ledger, controlled=False, rep=0):
+        """One (arm, mix, rep) cell: cold shared cache, fresh service,
+        static knobs or a live controller, one mix run. Returns
+        (analyses, row, actuations)."""
+        eval_cache.reset_cache()  # every arm does the same device work
+        svc = build_svc()
+        ctrl = None
+        try:
+            if arm == "static_narrow":
+                svc.set_coalesce_width(1)
+                svc.set_async_depth(1)
+            elif arm == "static_wide":
+                svc.set_coalesce_width(8)
+                svc.set_async_depth(4)
+            elif controlled:
+                # Scheduling knobs only (the bit-parity set); prefetch
+                # stays pinned by build_svc and is exercised in
+                # tests/test_control.py instead.
+                collector = SignalCollector(service=svc).attach()
+                registry = ActuatorRegistry()
+                registry.register_all([
+                    a for a in standard_actuators(service=svc)
+                    if a.name in ("coalesce_width", "pipeline_depth")
+                ])
+                ctrl = Controller(collector, registry)
+                ctrl.start(period_s=0.1)
+            tag = f"{arm}-{mix}-{rep}"
+            pro_out = run_prologue(svc, ledger, tag)
+            before = svc.counters()
+            runner = run_steady if mix == "steady" else run_bursty
+            out, elapsed, n = runner(svc, ledger, tag)
+            out = pro_out + out
+            after = svc.counters()
+            delta = {k: after[k] - before.get(k, 0) for k in after}
+            row = arm_row(arm, svc, elapsed, n, delta)
+            acts = list(ctrl.registry.recent()) if ctrl is not None else []
+            if ctrl is not None:
+                row["actuations"] = len(acts)
+            return out, row, acts
+        finally:
+            if ctrl is not None:
+                shutdown_controller(ctrl)
+            svc.gate.set()
+            svc.close()
+
+    arms = ("static_narrow", "static_wide", "controller")
+    ledger = accounting.install()
+    mixes: dict = {"steady": {}, "bursty": {}}
+    outputs: dict = {"steady": [], "bursty": []}
+    actuation_log = []
+    try:
+        for mix in ("steady", "bursty"):
+            for arm in arms:
+                # Best-of-N per cell: arms run seconds apart on a
+                # shared box, so a one-sided slowdown in any single
+                # run would dominate a 20% gate band.
+                for rep in range(CONTROL_REPS):
+                    out, row, acts = run_arm(
+                        arm, mix, ledger,
+                        controlled=(arm == "controller"), rep=rep,
+                    )
+                    outputs[mix].append((f"{arm}/r{rep}", out))
+                    best = mixes[mix].get(arm)
+                    if (best is None
+                            or row["searches_per_s"]
+                            > best["searches_per_s"]):
+                        mixes[mix][arm] = row
+                    actuation_log.extend({
+                        "mix": mix, "rep": rep, "window": a.window,
+                        "knob": a.knob, "direction": a.direction,
+                        "value": repr(a.value), "reason": a.reason,
+                    } for a in acts)
+                    log(f"bench: control {mix}/{arm} r{rep} {row}")
+
+        # Escape hatch: same controller wiring, FISHNET_NO_CONTROL=1.
+        # It must not actuate, and results must match the parity set.
+        saved = _os.environ.get("FISHNET_NO_CONTROL")
+        _os.environ["FISHNET_NO_CONTROL"] = "1"
+        try:
+            hatch_out, hatch_row, hatch_acts = run_arm(
+                "escape_hatch", "steady", ledger, controlled=True
+            )
+        finally:
+            if saved is None:
+                _os.environ.pop("FISHNET_NO_CONTROL", None)
+            else:
+                _os.environ["FISHNET_NO_CONTROL"] = saved
+        log(f"bench: control steady/escape_hatch {hatch_row}")
+        ledger_rep = ledger.report()
+    finally:
+        accounting.clear()
+
+    parity_identical = all(
+        out == outputs[mix][0][1]
+        for mix in ("steady", "bursty") for _label, out in outputs[mix]
+    )
+    hatch_clean = (
+        hatch_row.get("actuations", 0) == 0
+        and hatch_out == outputs["steady"][0][1]
+    )
+
+    def sps(mix, arm):
+        return mixes[mix][arm]["searches_per_s"]
+
+    statics = [a for a in arms if a != "controller"]
+    never_loses = all(
+        sps(mix, "controller")
+        >= max(sps(mix, a) for a in statics) * (1.0 - CONTROL_NOISE_BAND)
+        for mix in ("steady", "bursty")
+    )
+    wins_a_mix = any(
+        all(sps(mix, "controller") > sps(mix, a) for a in statics)
+        for mix in ("steady", "bursty")
+    )
+    actuated = sum(
+        row.get("actuations", 0)
+        for mix in ("steady", "bursty")
+        for row in mixes[mix].values()
+    ) > 0
+    gates = {
+        "never_loses": never_loses,
+        "wins_a_mix": wins_a_mix,
+        "actuated": actuated,
+        "noise_band": CONTROL_NOISE_BAND,
+        "passed": (
+            never_loses and wins_a_mix and actuated and parity_identical
+            and hatch_clean and not ledger_rep["lost"]
+            and not ledger_rep["duplicated"]
+        ),
+    }
+    return {
+        "metric": "controller_steady_searches_per_s",
+        "value": sps("steady", "controller"),
+        "unit": "searches/s",
+        "mode": "control",
+        "profile": profile_section(),
+        "nodes": nodes,
+        "arms": list(arms),
+        "steady": mixes["steady"],
+        "bursty": mixes["bursty"],
+        "escape_hatch": hatch_row,
+        "actuations": actuation_log,
+        "parity": {
+            "identical": parity_identical,
+            "escape_hatch": hatch_clean,
+            "positions": (
+                len(steady_jobs) + len(bursty_jobs)
+                + 2 * len(prologue_jobs)
+            ),
+        },
+        "gates": gates,
+        "ledger": ledger_rep,
+    }
+
+
 #: Fixed MCTS bench workload: 16 opening lines from the start position,
 #: cycled over the submitted trees. Lines (not scattered FENs) exercise
 #: transposition sharing (expansion memo / AzEvalCache) and the
@@ -2902,6 +3235,15 @@ def main(argv=None) -> None:
         "run_fleet_cache_bench)",
     )
     parser.add_argument(
+        "--control", action="store_true",
+        help="run the self-tuning control-plane A/B instead of the "
+        "throughput tiers: two traffic mixes (steady analysis, bursty "
+        "best-move) under static knob settings vs the live controller, "
+        "with bit-identical analyses across arms, an escape-hatch "
+        "phase (FISHNET_NO_CONTROL=1), and the exactly-once ledger "
+        "(see run_control_bench)",
+    )
+    parser.add_argument(
         "--mcts", action="store_true",
         help="run the shared-plane batched MCTS benchmark instead of "
         "the throughput tiers: AZ leaf traffic on the coalesced "
@@ -2923,6 +3265,16 @@ def main(argv=None) -> None:
     _telemetry.enable()
     _profiler.start()
     _cost.enable()
+
+    if args.control:
+        log(
+            f"bench: control mode — {CONTROL_NODES} nodes per search, "
+            "steady/bursty mixes x static/controller arms + escape "
+            "hatch..."
+        )
+        summary = run_control_bench()
+        emit_summary(summary, args.json_out)
+        return
 
     if args.mcts:
         log(

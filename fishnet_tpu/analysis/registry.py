@@ -70,6 +70,8 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/observability.md", "tests/test_async_dispatch.py"),
     Knob("FISHNET_NO_COALESCE", "env", "unset (coalescing on)",
          "doc/wire-format.md", "tests/test_coalesce.py"),
+    Knob("FISHNET_NO_CONTROL", "env", "unset (control plane may actuate)",
+         "doc/control-plane.md", "tests/test_control.py"),
     Knob("FISHNET_NO_DEDUP", "env", "unset (fused dedup on)",
          "doc/wire-format.md", "tests/test_eval_cache.py"),
     Knob("FISHNET_NO_EVAL_CACHE", "env", "unset (eval cache on)",
@@ -118,6 +120,8 @@ KNOBS: Tuple[Knob, ...] = (
     Knob("--batch-deadline", "cli", "unset (no deadline flushes)",
          "doc/resilience.md", "tests/test_configure.py"),
     Knob("--conf", "cli", "fishnet.ini next to the module", "README.md"),
+    Knob("--control", "cli", "off (bench.py / fleet console mode flag)",
+         "doc/control-plane.md", "tests/test_control.py"),
     Knob("--cores", "cli", "auto (n-1)", "README.md",
          "tests/test_configure.py"),
     Knob("--drain-deadline", "cli", "10s", "doc/resilience.md",

@@ -96,6 +96,26 @@ class ShedPolicy:
         self.shed_count = 0
         self.admit_count = 0
 
+    def set_watermarks(self, pair, low: Optional[int] = None) -> None:
+        """Control-plane actuation: re-tune the watermark pair at
+        runtime. Accepts ``set_watermarks((high, low))`` — the actuator
+        registry's pair-knob shape — or ``set_watermarks(high, low)``;
+        a missing low re-derives as high//2, and low is clamped under
+        high. The latency bound is left alone: it is an SLO-shaped
+        promise, not a congestion knob."""
+        if isinstance(pair, (tuple, list)):
+            high = pair[0]
+            if len(pair) > 1:
+                low = pair[1]
+        else:
+            high = pair
+        self.high_watermark = max(1, int(high))
+        self.low_watermark = min(
+            max(1, int(low)) if low is not None
+            else max(1, self.high_watermark // 2),
+            self.high_watermark,
+        )
+
     # -- capacity ---------------------------------------------------------
 
     def _scale(self) -> float:

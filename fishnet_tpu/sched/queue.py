@@ -347,8 +347,22 @@ class LaneScheduler:
         }
         self._rings: Dict[str, Deque[str]] = {lane: deque() for lane in LANES}
         self._credit: Dict[str, int] = {}  # throughput-lane DRR deficits
+        # Control-plane admission weights: a tenant's DRR turn refills
+        # round(quantum * weight) credits (min 1). Missing = 1.0.
+        self._weights: Dict[str, float] = {}
         #: Positions handed to workers, per tenant (fairness measure).
         self.served: Dict[str, int] = {}
+
+    def set_tenant_weights(self, weights: Optional[Dict[str, float]]) -> None:
+        """Control-plane actuation: REPLACE the admission-weight map
+        (None or {} restores unweighted DRR). Weights scale the credit
+        refill, so they reshape sustained throughput shares without
+        ever starving a tenant — every active tenant still gets a turn
+        with at least one credit."""
+        self._weights = dict(weights) if weights else {}
+
+    def tenant_weights(self) -> Dict[str, float]:
+        return dict(self._weights)
 
     def push(
         self, position: Position, tenant: str, lane: str,
@@ -393,7 +407,10 @@ class LaneScheduler:
                 continue
             credit = self._credit.get(tenant)
             if credit is None:
-                credit = self._credit[tenant] = self.quantum
+                weight = self._weights.get(tenant, 1.0)
+                credit = self._credit[tenant] = max(
+                    1, int(round(self.quantum * weight))
+                )
             if credit <= 0:
                 # Turn over: rotate to the back; credit refills on the
                 # next visit.

@@ -39,6 +39,7 @@ import threading
 import time
 import weakref
 from collections import OrderedDict
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -719,6 +720,34 @@ class MctsPool:
 
     def warmup(self) -> None:
         self._evaluator.warmup(self.cfg.batch_capacity)
+
+    # -- control-plane actuation seam (fishnet_tpu/control) ---------------
+
+    def leaf_width_max(self) -> int:
+        return self.cfg.leaves_per_step_max
+
+    def set_leaf_width_max(self, width: int) -> None:
+        """Control-plane actuation: re-bound the AIMD leaf-width
+        ceiling (the Batch-MCTS batch-width/latency tradeoff). Live
+        searches adopt the new ceiling immediately — widths above it
+        are clamped down; the collision-driven AIMD keeps floating
+        underneath. Only the CEILING moves: per-tree width stays owned
+        by the adaptation loop, so search results remain a function of
+        the same visit budget."""
+        width = max(1, int(width))
+        with self._lock:
+            self.cfg = dataclasses.replace(
+                self.cfg, leaves_per_step_max=width
+            )
+            cap = max(width, self.cfg.leaves_per_step)
+            for s in self._searches.values():
+                s.cfg = self.cfg
+                if s.leaf_width > cap:
+                    s.leaf_width = cap
+            for s in self._reuse.values():
+                s.cfg = self.cfg
+                if s.leaf_width > cap:
+                    s.leaf_width = cap
 
     def close(self) -> None:
         """Release the evaluator (plane pipelines/collector when this

@@ -30,7 +30,7 @@ import time
 import weakref
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Optional, Sequence, Tuple, Union
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -736,6 +736,11 @@ class _DispatchCoalescer:
             s: [] for s in range(n_shards)
         }
         self._pinned = pinned_width
+        # Control-plane width override, per shard (None = let the
+        # probe policy decide). Precedence: env pin > override > probe.
+        self._override: Dict[int, Optional[int]] = {
+            s: None for s in range(n_shards)
+        }
         self._probe: Optional[DispatchProbe] = None
         self._occ_ema: Dict[int, Optional[float]] = {
             s: None for s in range(n_shards)
@@ -772,11 +777,32 @@ class _DispatchCoalescer:
             for s in range(self._n_shards):
                 self._recompute_width(s)
 
+    def set_width_override(self, width: Optional[int],
+                           shards: Optional[Iterable[int]] = None) -> None:
+        """Control-plane actuation: force the policy width on the given
+        shards (None = all; width None clears back to the probe
+        policy). An env pin (FISHNET_COALESCE_WIDTH) still wins —
+        operator intent outranks the controller."""
+        with self._lock:
+            targets = (
+                range(self._n_shards) if shards is None
+                else [s for s in shards if 0 <= s < self._n_shards]
+            )
+            for s in targets:
+                self._override[s] = None if width is None else int(width)
+                self._recompute_width(s)
+
     def _recompute_width(self, shard: int) -> None:
         # Caller holds self._lock (the router's lock is a leaf — safe
         # to take underneath).
         if self._pinned is not None:
             self._widths[shard] = max(1, min(self._pinned, self.MAX_WIDTH))
+            return
+        override = self._override.get(shard)
+        if override is not None:
+            self._widths[shard] = max(1, min(override, self.MAX_WIDTH))
+            if self._svc.driver_threads > 1 and self._widths[shard] > 1:
+                self._linger_s = self.MAX_LINGER_S
             return
         if self._probe is None:
             return  # width stays 1 until the warmup probe lands
@@ -1021,8 +1047,13 @@ class _AsyncDispatchPipeline:
     restoring the synchronous inline flush.
     """
 
-    #: Ping-pong double buffer: two dispatches in flight, no more.
+    #: Ping-pong double buffer: the STATIC default depth — two
+    #: dispatches in flight unless the control plane re-tunes it.
     DEPTH = 2
+
+    #: Hard ceiling on the runtime-tunable depth (and the size of the
+    #: staging ring, so a depth change never re-maps live slots).
+    MAX_DEPTH = 4
 
     def __init__(self, svc: "CoalesceBackend", shard: int = 0,
                  seq_alloc: Optional["_SeqAllocator"] = None) -> None:
@@ -1041,12 +1072,21 @@ class _AsyncDispatchPipeline:
         self._pack_q: "queue.Queue" = queue.Queue()
         self._decode_q: "queue.Queue" = queue.Queue()
         self._slots = threading.Semaphore(self.DEPTH)
-        # Staging-slot occupancy (index = lseq % DEPTH): the pack worker
-        # asserts a slot is free before staging into it. Releases are
-        # FIFO (the decode worker materializes in dispatch order), so
-        # the semaphore alone already guarantees this — the flags are
-        # the donation-correctness guard the async tests pin.
-        self._staging_inuse = [False] * self.DEPTH
+        # Runtime-tunable depth (control plane): the semaphore holds
+        # `_depth` permits; deepening releases extra permits, and
+        # shallowing records a deficit that _release() absorbs instead
+        # of returning permits — the pack worker never blocks on a
+        # depth change.
+        self._depth = self.DEPTH
+        self._depth_deficit = 0
+        # Staging-slot occupancy (index = lseq % MAX_DEPTH — the ring
+        # is sized for the deepest tunable depth, so depth changes
+        # never re-map a live slot): the pack worker asserts a slot is
+        # free before staging into it. Releases are FIFO (the decode
+        # worker materializes in dispatch order), so the semaphore
+        # alone already guarantees this — the flags are the
+        # donation-correctness guard the async tests pin.
+        self._staging_inuse = [False] * self.MAX_DEPTH
         self._seq = 0
         self._lseq = 0
         self._stopping = False
@@ -1108,6 +1148,30 @@ class _AsyncDispatchPipeline:
             busy, dual = self._busy_s, self._dual_s
         return dual / busy if busy > 0 else 0.0
 
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth
+
+    def set_depth(self, depth: int) -> None:
+        """Re-tune the in-flight depth at runtime (control plane;
+        bounded 1..MAX_DEPTH). Deepening releases semaphore permits
+        immediately; shallowing books a deficit that _release()
+        absorbs as in-flight dispatches drain — nothing ever blocks
+        waiting for the pipeline to shrink."""
+        depth = max(1, min(self.MAX_DEPTH, int(depth)))
+        with self._lock:
+            delta = depth - self._depth
+            self._depth = depth
+            if delta > 0:
+                cancel = min(self._depth_deficit, delta)
+                self._depth_deficit -= cancel
+                release = delta - cancel
+            else:
+                self._depth_deficit += -delta
+                release = 0
+        for _ in range(release):
+            self._slots.release()
+
     def close(self, timeout: float = 10.0) -> None:
         with self._lock:
             self._stopping = True
@@ -1134,6 +1198,11 @@ class _AsyncDispatchPipeline:
     def _release(self, slot: int) -> None:
         with self._lock:
             self._staging_inuse[slot] = False
+            if self._depth_deficit > 0:
+                # A set_depth() shrink is pending: absorb this permit
+                # instead of returning it to the pool.
+                self._depth_deficit -= 1
+                return
         self._slots.release()
 
     def _fail_queued(self, err: BaseException) -> None:
@@ -1160,7 +1229,7 @@ class _AsyncDispatchPipeline:
                 return
             seq, lseq, tickets = item
             self._slots.acquire()  # wait for a free ping-pong slot
-            slot = lseq % self.DEPTH
+            slot = lseq % self.MAX_DEPTH
             with self._lock:
                 staging_free = not self._staging_inuse[slot]
                 self._staging_inuse[slot] = True
@@ -1240,7 +1309,7 @@ class _AsyncDispatchPipeline:
                 # as a driver crash), so nothing is swallowed.
                 _COALESCE_ERRORS.inc()
             self._mark(-1)
-            self._release(lseq % self.DEPTH)
+            self._release(lseq % self.MAX_DEPTH)
             if tickets and tickets[0].cost_t0:
                 # Deferred cost record (telemetry/cost.py): the wall
                 # from pack-issue to materialization — transfer +
@@ -2162,6 +2231,44 @@ class SearchService(CoalesceBackend):
         self._lib.fc_pool_set_prefetch(
             self._pool, int(budget), 1 if adaptive else 0
         )
+
+    # -- control-plane actuation seams (fishnet_tpu/control) --------------
+    # Bounded, revertible setters over SCHEDULING knobs only — none of
+    # these can change what any position evaluates to, which is why
+    # analyses stay bit-identical with the controller on.
+
+    def set_coalesce_width(self, width: Optional[int],
+                           shards: Optional[Iterable[int]] = None) -> None:
+        """Force the coalesce policy width on the given shards (None =
+        all; width None restores the probe policy). No-op without a
+        coalescer (FISHNET_NO_COALESCE=1)."""
+        co = self._coalescer
+        if co is not None:
+            co.set_width_override(width, shards=shards)
+
+    def coalesce_width(self) -> Optional[int]:
+        """The live effective coalesce width (None when coalescing is
+        disabled)."""
+        co = self._coalescer
+        return co.width if co is not None else None
+
+    def set_async_depth(self, depth: Optional[int]) -> None:
+        """Re-tune every shard's async-dispatch in-flight depth
+        (bounded 1..MAX_DEPTH; None restores the static default).
+        Named apart from the ``pipeline_depth`` constructor knob — that
+        one is NNUE group pipelining, this one is the ping-pong
+        dispatch pipeline. No-op in synchronous mode
+        (FISHNET_NO_ASYNC=1)."""
+        if depth is None:
+            depth = _AsyncDispatchPipeline.DEPTH
+        for pipe in self._async_pipes:
+            pipe.set_depth(depth)
+
+    def async_depth(self) -> Optional[int]:
+        """The widest live async-dispatch depth (None in synchronous
+        mode)."""
+        pipes = self._async_pipes
+        return max(p.depth() for p in pipes) if pipes else None
 
     #: Prefetch-steering hysteresis (FISHNET_CACHE_PREFETCH=1): pin the
     #: speculation budget to 0 when the cache hit rate crosses _PIN

@@ -579,9 +579,39 @@ def _profile_panel(procs) -> List[str]:
     return lines
 
 
-def render_console(agg: FleetAggregator, profiles: bool = False) -> str:
+def _control_panel(procs) -> List[str]:
+    """Per-proc control-plane view (--control): total actuations from
+    ``fishnet_control_actuations_total`` plus the last few entries of
+    each proc's ``fishnet_control_actuation_log`` ring (newest last,
+    ordered by the per-proc actuation seq; the log's value is the
+    signal window that decided it)."""
+    lines: List[str] = ["", "CONTROL PLANE (last actuations per proc)"]
+    for name, st in procs:
+        total = _sum_samples(st, "fishnet_control_actuations_total")
+        if total is None:
+            lines.append(f"{name:<10} control plane off")
+            continue
+        lines.append(f"{name:<10} {total:.0f} actuations")
+        fam = st.families.get("fishnet_control_actuation_log")
+        rows = sorted(
+            fam.samples, key=lambda s: int(s.labels.get("seq", "0"))
+        ) if fam is not None else []
+        for s in rows:
+            lines.append(
+                f"  #{s.labels.get('seq', '?'):>3} w{s.value:<5.0f} "
+                f"{s.labels.get('knob', '?'):<16} "
+                f"{s.labels.get('direction', '?'):<6} "
+                f"-> {s.labels.get('to', '?')}"
+            )
+    return lines
+
+
+def render_console(
+    agg: FleetAggregator, profiles: bool = False, control: bool = False
+) -> str:
     """One console frame: per-proc serving state + SLO table (+ the
-    hottest-stacks panel with ``profiles=True``)."""
+    hottest-stacks panel with ``profiles=True``, + the control-plane
+    actuation panel with ``control=True``)."""
     now = time.time()
     lines: List[str] = []
     with agg._lock:
@@ -622,6 +652,8 @@ def render_console(agg: FleetAggregator, profiles: bool = False) -> str:
         slo_rows = agg.slo.evaluate(now)
         if profiles:
             lines.extend(_profile_panel(procs))
+        if control:
+            lines.extend(_control_panel(procs))
     lines.append("")
     lines.append(f"{'SLO':<20} {'OBJ':>6} {'STATUS':<8} WINDOWS")
     for row in slo_rows:
@@ -641,10 +673,11 @@ def run_console(
     once: bool = False,
     out=sys.stdout,
     profiles: bool = False,
+    control: bool = False,
 ) -> None:
     """Render the console in place until interrupted (or once)."""
     while True:
-        frame = render_console(agg, profiles=profiles)
+        frame = render_console(agg, profiles=profiles, control=control)
         if once:
             out.write(frame + "\n")
             return
@@ -693,6 +726,13 @@ def main(argv: Optional[List[str]] = None) -> int:
              "top-5 hottest-stacks panel (targets with the profiling "
              "plane off show 'profiling off'); default table unchanged",
     )
+    parser.add_argument(
+        "--control", action="store_true",
+        help="also show the control-plane panel: per-proc actuation "
+             "totals and the last few fishnet_control_actuation_log "
+             "entries (targets without the control plane show "
+             "'control plane off'); default table unchanged",
+    )
     args = parser.parse_args(argv)
     static: Dict[str, str] = {}
     for i, t in enumerate(args.targets):
@@ -718,11 +758,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.json:
                 print(json.dumps(agg.fleet_doc(), indent=2))
             else:
-                run_console(agg, once=True, profiles=args.profiles)
+                run_console(
+                    agg, once=True, profiles=args.profiles,
+                    control=args.control,
+                )
             return 0
         agg.start()
         run_console(
-            agg, interval=max(0.2, args.interval), profiles=args.profiles
+            agg, interval=max(0.2, args.interval), profiles=args.profiles,
+            control=args.control,
         )
     except KeyboardInterrupt:
         pass

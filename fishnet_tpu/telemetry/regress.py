@@ -141,6 +141,26 @@ SERIES_SPECS: Tuple[Spec, ...] = (
          0.0, "gate"),
     Spec("FLEETCACHE", "gates_passed", "gates.passed", "true", 0.0,
          "gate"),
+    # -- CONTROL (self-tuning control plane; bench.py --control) ---------
+    # Headline = controller-on steady-mix throughput; the gates are the
+    # A/B verdicts bench.py computes against every static arm.
+    Spec("CONTROL", "controller_steady_sps", "value", "up", 0.30,
+         "watch"),
+    Spec("CONTROL", "controller_never_loses", "gates.never_loses",
+         "true", 0.0, "gate"),
+    Spec("CONTROL", "controller_wins_a_mix", "gates.wins_a_mix",
+         "true", 0.0, "gate"),
+    Spec("CONTROL", "actuations_nonzero", "gates.actuated", "true",
+         0.0, "gate"),
+    Spec("CONTROL", "parity_identical", "parity.identical", "true",
+         0.0, "gate"),
+    Spec("CONTROL", "escape_hatch_identical", "parity.escape_hatch",
+         "true", 0.0, "gate"),
+    Spec("CONTROL", "ledger_lost", "ledger.lost", "zero", 0.0, "gate"),
+    Spec("CONTROL", "ledger_duplicated", "ledger.duplicated", "zero",
+         0.0, "gate"),
+    Spec("CONTROL", "gates_passed", "gates.passed", "true", 0.0,
+         "gate"),
     # -- MCTS (shared-plane AZ bench) ------------------------------------
     Spec("MCTS", "warm_visits_per_s", "value", "up", 0.20, "gate"),
     Spec("MCTS", "cold_visits_per_s", "cold.visits_per_s", "up", 0.25,

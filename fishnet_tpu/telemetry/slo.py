@@ -303,6 +303,26 @@ class SLOEngine:
             out.append(entry)
         return out
 
+    def burn_snapshot(
+        self,
+        families: Optional[Mapping[str, MetricFamily]] = None,
+        now: Optional[float] = None,
+    ) -> Dict[str, dict]:
+        """Programmatic burn view for IN-PROCESS consumers (the control
+        plane's SignalCollector) — one observe + evaluate, returned as
+        ``{slo_name: evaluate() entry}``, so nothing ever scrapes its
+        own process over HTTP to learn its burn state.
+
+        ``families`` defaults to the local process registry's live
+        collect(); pass an explicit mapping when feeding federated
+        families (the fleet aggregator's per-proc engines do)."""
+        if families is None:
+            from fishnet_tpu.telemetry.registry import REGISTRY
+
+            families = {fam.name: fam for fam in REGISTRY.collect()}
+        self.observe(families, now)
+        return {entry["slo"]: entry for entry in self.evaluate(now)}
+
     def families(self, now: Optional[float] = None) -> List[MetricFamily]:
         """``fishnet_slo_burn_rate{slo,window}`` +
         ``fishnet_slo_status{slo}`` (0 ok / 1 burning / 2 breach) for

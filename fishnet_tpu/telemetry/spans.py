@@ -86,7 +86,7 @@ STAGES = (
 EVENT_STAGES = (
     "recover", "coalesce", "dispatch_issue", "dispatch_wait",
     "mcts_collect", "queue_wait", "submit", "admit", "cache_probe",
-    "drain",
+    "drain", "control",
 )
 
 #: Span-dump header format. /2 added the additive causal-trace fields

@@ -1,0 +1,746 @@
+"""Self-tuning control plane (doc/control-plane.md): signal folding
+and hysteresis units, the bounded/revertible actuator registry, the
+deterministic rule/probe policy (exact decision tables — the decision
+path has no wall clock, so the same window sequence must replay the
+same actions), degraded-shard skip, the ``FISHNET_NO_CONTROL``
+byte-for-byte escape hatch, the ``burn_snapshot()`` SLO seam, the
+subsystem actuation seams (service width/depth, shed watermarks, DRR
+tenant weights), and the fleet console ``--control`` panel. The one
+real-service test drives the controller end to end with injected
+transport latency and checks the knob actually moved and reverted."""
+
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from fishnet_tpu.control import (
+    Action,
+    Actuator,
+    ActuatorRegistry,
+    Controller,
+    ControlSignals,
+    HysteresisSwitch,
+    LadderProbe,
+    NO_CONTROL_ENV,
+    RuleProbePolicy,
+    SignalCollector,
+    control_enabled,
+)
+from fishnet_tpu.control.controller import WIDTH_LADDER, standard_actuators
+from fishnet_tpu.control.signals import _StageAccum
+from fishnet_tpu.telemetry.registry import MetricFamily, Sample
+
+
+def _fam(name, rows, type="counter"):
+    fam = MetricFamily(name=name, type=type, help="test fixture")
+    for labels, value in rows:
+        fam.samples.append(Sample(name=name, value=value, labels=labels))
+    return fam
+
+
+# ---------------------------------------------------------------------------
+# Signal folding
+# ---------------------------------------------------------------------------
+
+
+def test_stage_accum_folds_across_threads():
+    accum = _StageAccum()
+    accum.observe("pack", 0.010)
+
+    def worker():
+        accum.observe("pack", 0.020)
+        accum.observe("coalesce", 0.005)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    folded = accum.fold()
+    assert folded["pack"][0] == pytest.approx(0.030)
+    assert folded["pack"][1] == 2.0
+    assert folded["coalesce"] == [pytest.approx(0.005), 1.0]
+
+
+def test_hysteresis_switch_margin_and_hold():
+    sw = HysteresisSwitch(margin=0.10, hold=2)
+    # First observation seats the dominant immediately.
+    assert sw.update({"pack": 0.6, "transport": 0.4}) == "pack"
+    # A challenger inside the margin never starts a streak.
+    assert sw.update({"pack": 0.46, "transport": 0.54}) == "pack"
+    # Outside the margin it still needs `hold` consecutive windows.
+    assert sw.update({"pack": 0.3, "transport": 0.7}) == "pack"
+    assert sw.update({"pack": 0.3, "transport": 0.7}) == "transport"
+    # One calm window resets the streak.
+    assert sw.update({"pack": 0.3, "compute": 0.7}) == "transport"
+    assert sw.update({"pack": 0.7, "compute": 0.3}) == "transport"
+    assert sw.update({"pack": 0.3, "compute": 0.7}) == "transport"
+    assert sw.update({"pack": 0.3, "compute": 0.7}) == "compute"
+
+
+def test_collector_window_deltas_and_dominant():
+    state = {"eval_steps": 0, "evals_shipped": 0, "cache_prewire_hits": 0}
+
+    def counters():
+        return dict(state)
+
+    col = SignalCollector(counters_fn=counters)
+    col.feed("dispatch_issue", 0.200)
+    col.feed("coalesce", 0.100)
+    col.feed("wire_decode", 0.050)
+    state.update(eval_steps=40, evals_shipped=10, cache_prewire_hits=8)
+    sig = col.sample()
+    assert sig.window == 1
+    assert sig.components["transport"] == pytest.approx(300.0)
+    assert sig.components["decode_wait"] == pytest.approx(50.0)
+    assert sig.dominant == "transport"
+    assert sig.counters["eval_steps"] == 40.0
+    assert sig.cache_hit_rate == pytest.approx(0.8)
+
+    # The next window sees only the NEW durations and counter deltas.
+    col.feed("dispatch_issue", 0.010)
+    state.update(eval_steps=55)
+    sig2 = col.sample()
+    assert sig2.window == 2
+    assert sig2.components["transport"] == pytest.approx(10.0)
+    assert sig2.components["decode_wait"] == 0.0
+    assert sig2.counters["eval_steps"] == 15.0
+
+    # A silent window keeps the smoothed dominant, share 0.
+    sig3 = col.sample()
+    assert sig3.dominant == "transport"
+    assert sig3.dominant_share == 0.0
+
+
+def test_collector_baselines_shard_rungs():
+    """A healthy service may idle mid-ladder (CPU serves from "xla"),
+    so rung degradation is measured against the healthiest rung seen
+    per shard, not against absolute rung 0."""
+    report = {"rung_index": [1, 1], "occupancy": [0.5, 0.5]}
+    svc = SimpleNamespace(
+        shard_report=lambda: {k: list(v) for k, v in report.items()},
+        counters=lambda: {},
+    )
+    col = SignalCollector(service=svc)
+    assert col.sample().shard_rungs == [0, 0]
+    report["rung_index"] = [1, 3]  # shard 1 degrades two rungs
+    assert col.sample().shard_rungs == [0, 2]
+    report["rung_index"] = [0, 1]  # shard 0 turns out to go lower
+    assert col.sample().shard_rungs == [0, 0]
+    report["rung_index"] = [1, 1]
+    assert col.sample().shard_rungs == [1, 0]
+
+
+# ---------------------------------------------------------------------------
+# Actuator registry: bounds, revert, escape hatch
+# ---------------------------------------------------------------------------
+
+
+def test_registry_clamps_scalar_pair_and_map():
+    calls = []
+    reg = ActuatorRegistry()
+    try:
+        reg.register_all([
+            Actuator("width", lambda v: calls.append(("width", v)),
+                     lo=1, hi=8, default=2),
+            Actuator("marks", lambda v: calls.append(("marks", v)),
+                     lo=32, hi=4096, default=(256, 128)),
+            Actuator("weights", lambda v: calls.append(("weights", v)),
+                     lo=0.25, hi=4.0, default={}),
+        ])
+        assert reg.apply("width", 64).value == 8
+        assert reg.apply("width", -3).value == 1
+        assert reg.apply("marks", (8192, 8)).value == (4096, 32)
+        assert reg.apply("weights", {"a": 9.0, "b": 0.01}).value == {
+            "a": 4.0, "b": 0.25,
+        }
+        assert calls == [
+            ("width", 8), ("width", 1),
+            ("marks", (4096, 32)), ("weights", {"a": 4.0, "b": 0.25}),
+        ]
+        # Unknown knob and value-already-current are both no-ops.
+        assert reg.apply("nope", 1) is None
+        assert reg.apply("width", 1) is None
+    finally:
+        reg.close()
+
+
+def test_registry_revert_restores_default():
+    seen = []
+    reg = ActuatorRegistry()
+    try:
+        reg.register(Actuator("depth", seen.append, lo=1, hi=4, default=2))
+        assert reg.revert("depth") is None  # nothing applied yet
+        entry = reg.apply("depth", 4, reason="test", window=7)
+        assert (entry.direction, entry.window) == ("up", 7)
+        back = reg.revert("depth")
+        assert back.direction == "revert"
+        assert seen == [4, 2]
+        # Revert is one-shot until the knob moves again.
+        assert reg.revert("depth") is None
+        assert [e.knob for e in reg.recent()] == ["depth", "depth"]
+    finally:
+        reg.close()
+
+
+def test_escape_hatch_refuses_apply_but_reverts(monkeypatch):
+    seen = []
+    reg = ActuatorRegistry()
+    try:
+        reg.register(Actuator("width", seen.append, lo=1, hi=8, default=2))
+        monkeypatch.delenv(NO_CONTROL_ENV, raising=False)
+        assert control_enabled()
+        reg.apply("width", 8)
+        monkeypatch.setenv(NO_CONTROL_ENV, "1")
+        assert not control_enabled()
+        assert reg.apply("width", 4) is None
+        assert seen == [8]  # the refused apply never reached the setter
+        # Restoring static defaults is exactly what the hatch promises.
+        assert reg.revert_all()[0].value == 2
+        assert seen == [8, 2]
+    finally:
+        reg.close()
+
+
+def test_actuation_log_rides_the_metrics_registry():
+    from fishnet_tpu.telemetry import REGISTRY
+
+    reg = ActuatorRegistry()
+    reg.register(Actuator(
+        "t_log_knob", lambda v: None, lo=1, hi=8, default=1,
+    ))
+    reg.apply("t_log_knob", 4, window=3)
+
+    def log_samples():
+        out = []
+        for fam in REGISTRY.collect():
+            if fam.name == "fishnet_control_actuation_log":
+                out.extend(
+                    s for s in fam.samples
+                    if s.labels.get("knob") == "t_log_knob"
+                )
+        return out
+
+    rows = log_samples()
+    assert len(rows) == 1
+    assert rows[0].value == 3.0  # value carries the signal window
+    assert rows[0].labels["direction"] == "up"
+    assert rows[0].labels["to"] == "4"
+    # Actuation counters ride the global registry alongside the log.
+    fams = {f.name: f for f in REGISTRY.collect()}
+    totals = fams["fishnet_control_actuations_total"]
+    assert any(
+        s.labels.get("knob") == "t_log_knob"
+        and s.labels.get("direction") == "up" and s.value >= 1.0
+        for s in totals.samples
+    )
+    reg.close()
+    assert log_samples() == []  # close() unhooks the pull collector
+
+
+def test_control_span_stage_registered():
+    from fishnet_tpu.telemetry.spans import EVENT_STAGES
+
+    assert "control" in EVENT_STAGES
+
+
+# ---------------------------------------------------------------------------
+# LadderProbe: deterministic hill-climb schedule
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_probe_index_of():
+    probe = LadderProbe()
+    assert probe.ladder == WIDTH_LADDER
+    assert probe.index_of(1) == 0
+    assert probe.index_of(8) == 3
+    assert probe.index_of(3) == 1  # off-ladder pins snap to nearest rung
+    assert probe.index_of(100) == 3
+
+
+def test_ladder_probe_keeps_improvements_and_narrows_first():
+    probe = LadderProbe(settle=2, min_gain=0.05)
+    idx = 2  # width 4
+    # Two settle windows measure the reference, then a NARROWER trial.
+    assert probe.update(idx, 10.0) is None
+    assert probe.update(idx, 10.0) == (1, "trial")
+    idx = 1
+    # The trial improves ≥ min_gain: keep it, no move emitted.
+    assert probe.update(idx, 12.0) is None
+    assert probe.update(idx, 12.0) is None
+    # Next measurement cycle continues downhill from the new rung.
+    assert probe.update(idx, 12.0) is None
+    assert probe.update(idx, 12.0) == (0, "trial")
+
+
+def test_ladder_probe_reverts_and_backs_off_on_regression():
+    probe = LadderProbe(settle=1, min_gain=0.05, max_hold=4)
+    # Reference at rung 1, trial at rung 0 regresses -> step back.
+    assert probe.update(1, 10.0) == (0, "trial")
+    assert probe.update(0, 8.0) == (1, "revert")
+    # Backoff: one hold window swallowed, then direction flips upward.
+    assert probe.update(1, 10.0) is None
+    assert probe.update(1, 10.0) == (2, "trial")
+    # A second failure doubles the hold (capped at max_hold).
+    assert probe.update(2, 5.0) == (1, "revert")
+    assert probe.update(1, 10.0) is None
+    assert probe.update(1, 10.0) is None
+    assert probe.update(1, 10.0) == (0, "trial")
+
+
+def test_ladder_probe_edge_rungs_flip_direction():
+    probe = LadderProbe(settle=1)
+    # At the bottom rung the narrower trial is impossible: flip up.
+    assert probe.update(0, 10.0) == (1, "trial")
+
+
+# ---------------------------------------------------------------------------
+# RuleProbePolicy: exact decision tables
+# ---------------------------------------------------------------------------
+
+
+def _sig(window, dominant=None, share=0.0, counters=None, slo=None,
+         cost=None, hit=0.0):
+    sig = ControlSignals(window=window)
+    sig.dominant = dominant
+    sig.dominant_share = share
+    sig.counters = dict(counters or {})
+    sig.slo_status = dict(slo or {})
+    sig.tenant_cost_share = dict(cost or {})
+    sig.cache_hit_rate = hit
+    return sig
+
+
+def _run_width_schedule():
+    """One fixed transport-dominant window sequence -> action list."""
+    policy = RuleProbePolicy()
+    policy.width_probe = LadderProbe(settle=2, min_gain=0.05)
+    knobs = {"coalesce_width": 4, "pipeline_depth": None}
+    scores = [10.0, 10.0, 8.0, 8.0, 10.0, 10.0]
+    out = []
+    for w, score in enumerate(scores, start=1):
+        sig = _sig(w, dominant="transport", share=0.9,
+                   counters={"eval_steps": score})
+        actions = policy.decide(sig, dict(knobs))
+        for a in actions:
+            knobs[a.knob] = a.value  # pretend the registry applied it
+        out.append(tuple((a.knob, a.value, a.reason) for a in actions))
+    return out
+
+
+def test_policy_width_probe_decision_table():
+    table = _run_width_schedule()
+    # Windows 1-2 measure; window 2 emits the narrower trial; the
+    # regressed trial steps back at window 4; backoff swallows 5-6.
+    assert table[0] == ()
+    assert table[1] == ((
+        "coalesce_width", 2,
+        "transport-dominated (90%): probe trial",
+    ),)
+    assert table[3] == ((
+        "coalesce_width", 4,
+        "transport-dominated (90%): trial regressed, step back",
+    ),)
+    assert table[2] == table[4] == table[5] == ()
+    # Determinism: the same window sequence replays the same actions.
+    assert table == _run_width_schedule()
+
+
+def test_policy_decode_queue_deepens_pipeline():
+    policy = RuleProbePolicy()
+    sig = _sig(1, counters={"decode_queue": 3.0, "eval_steps": 5.0})
+    actions = policy.decide(sig, {"pipeline_depth": 2})
+    assert actions == [Action(
+        "pipeline_depth", 3, "standing decode queue: deepen the async "
+        "pipeline",
+    )]
+    # The rule respects the depth cap.
+    assert policy.decide(sig, {"pipeline_depth": 4}) == []
+
+
+def test_policy_slo_burn_tightens_and_downweights():
+    policy = RuleProbePolicy()
+    sig = _sig(1, slo={"move_latency": "burning"},
+               cost={"hog": 0.8, "meek": 0.2})
+    actions = policy.decide(sig, {
+        "shed_watermark": (256, 128), "tenant_weights": {},
+    })
+    assert ("shed_watermark", (128, 64)) in [
+        (a.knob, a.value) for a in actions
+    ]
+    assert ("tenant_weights", {"hog": 0.5}) in [
+        (a.knob, a.value) for a in actions
+    ]
+    # At the floor the watermark stops tightening; a balanced cost
+    # book never downweights anybody.
+    calm = policy.decide(
+        _sig(2, slo={"x": "breach"}, cost={"a": 0.5, "b": 0.5}),
+        {"shed_watermark": (64, 32), "tenant_weights": {}},
+    )
+    assert calm == []
+
+
+def test_policy_prefetch_pin_unpin():
+    policy = RuleProbePolicy()
+    live = {"eval_steps": 10.0}
+    pin = policy.decide(
+        _sig(1, counters=live, hit=0.7), {"prefetch_budget": None}
+    )
+    assert pin == [Action(
+        "prefetch_budget", 0, "cache hot (70%): pin prefetch off",
+    )]
+    unpin = policy.decide(
+        _sig(2, counters=live, hit=0.1), {"prefetch_budget": 0}
+    )
+    assert unpin == [Action(
+        "prefetch_budget", None, "cache cold (10%): restore adaptive "
+        "prefetch",
+    )]
+    # Inside the hysteresis band nothing moves either way.
+    assert policy.decide(
+        _sig(3, counters=live, hit=0.5), {"prefetch_budget": 0}
+    ) == []
+
+
+def test_policy_calm_stepback_waits_for_quiescence():
+    policy = RuleProbePolicy(calm_hold=3)
+    knobs = {"coalesce_width": 2, "pipeline_depth": None,
+             "prefetch_budget": 0}
+    # hit=0.5 sits inside the pin/unpin hysteresis band, so the
+    # prefetch rule stays silent while the pin is in place.
+    quiet = lambda w: _sig(w, hit=0.5)  # noqa: E731 - no traffic
+    assert policy.decide(quiet(1), knobs) == []
+    assert policy.decide(quiet(2), knobs) == []
+    # A live window resets the calm streak.
+    assert policy.decide(
+        _sig(3, counters={"eval_steps": 4.0}, hit=0.5), knobs
+    ) == []
+    assert policy.decide(quiet(4), knobs) == []
+    assert policy.decide(quiet(5), knobs) == []
+    # Third consecutive quiet window: step ONE knob back — and never
+    # the prefetch pin, which the hit-rate rule owns.
+    assert policy.decide(quiet(6), knobs) == [Action(
+        "coalesce_width", None, "calm for 3 windows: step back",
+    )]
+
+
+# ---------------------------------------------------------------------------
+# Controller: degraded-shard skip
+# ---------------------------------------------------------------------------
+
+
+class _Feed:
+    """Stub collector replaying crafted ControlSignals windows."""
+
+    def __init__(self, sigs):
+        self._sigs = list(sigs)
+
+    def sample(self):
+        return self._sigs.pop(0)
+
+
+class _Fixed:
+    def __init__(self, actions):
+        self._actions = list(actions)
+
+    def decide(self, sig, knobs):
+        return list(self._actions)
+
+
+def test_controller_skips_degraded_shards():
+    calls = []
+
+    def setter(value, shards=None):
+        calls.append((value, shards))
+
+    sigs = []
+    for rungs in ([0, 0], [0, 1], [2, 1]):
+        sig = ControlSignals(window=len(sigs) + 1)
+        sig.shard_rungs = list(rungs)
+        sigs.append(sig)
+    reg = ActuatorRegistry()
+    try:
+        reg.register(Actuator(
+            "coalesce_width", setter, lo=1, hi=8, default=None,
+            shard_scoped=True,
+        ))
+        ctrl = Controller(
+            _Feed(sigs), reg,
+            policy=_Fixed([Action("coalesce_width", 8, "test")]),
+        )
+        # All healthy: actuate every shard (shards=None).
+        assert len(ctrl.step()) == 1
+        # One shard mid-degradation: actuate only the healthy one —
+        # the degradation ladder already owns the sick shard's knob.
+        assert len(ctrl.step()) == 1
+        # Every shard degraded: the action is skipped outright.
+        assert ctrl.step() == []
+        assert calls == [(8, None), (8, [0])]
+        assert ctrl.last_signals.shard_rungs == [2, 1]
+    finally:
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO burn_snapshot seam
+# ---------------------------------------------------------------------------
+
+
+def test_burn_snapshot_statuses_from_synthetic_families():
+    from fishnet_tpu.telemetry.slo import SLO, Selector, SLOEngine
+
+    slo = SLO(
+        name="t_success", description="test", objective=0.99,
+        total=Selector("t_requests_total"),
+        bad=Selector("t_requests_total", {"outcome": "error"}),
+    )
+    eng = SLOEngine(slos=[slo], windows=(60.0, 300.0))
+    fams = {"t_requests_total": _fam("t_requests_total", [
+        ({"outcome": "ok"}, 100.0),
+    ])}
+    first = eng.burn_snapshot(families=fams, now=0.0)
+    assert set(first) == {"t_success"}
+    assert first["t_success"]["status"] == "ok"
+
+    fams = {"t_requests_total": _fam("t_requests_total", [
+        ({"outcome": "ok"}, 150.0), ({"outcome": "error"}, 50.0),
+    ])}
+    hot = eng.burn_snapshot(families=fams, now=30.0)["t_success"]
+    # Half the window's traffic errored against a 1% budget: every
+    # window burns, so the status escalates straight to breach.
+    assert hot["status"] == "breach"
+    assert all(burn > 1.0 for burn in hot["windows"].values())
+
+
+def test_burn_snapshot_defaults_to_local_registry():
+    from fishnet_tpu.telemetry.slo import SLOEngine
+
+    snap = SLOEngine().burn_snapshot()
+    assert "move_latency" in snap and "api_success" in snap
+    assert all(
+        entry["status"] in ("ok", "burning", "breach")
+        for entry in snap.values()
+    )
+
+
+# ---------------------------------------------------------------------------
+# Subsystem actuation seams
+# ---------------------------------------------------------------------------
+
+
+def test_shed_policy_set_watermarks():
+    from fishnet_tpu.resilience.shedding import ShedPolicy
+
+    shed = ShedPolicy(high_watermark=256)
+    assert (shed.high_watermark, shed.low_watermark) == (256, 128)
+    shed.set_watermarks((128, 64))  # the registry's pair-knob shape
+    assert (shed.high_watermark, shed.low_watermark) == (128, 64)
+    shed.set_watermarks(512)  # scalar: low re-derives as high // 2
+    assert (shed.high_watermark, shed.low_watermark) == (512, 256)
+    shed.set_watermarks((100, 400))  # low clamps to at most high
+    assert (shed.high_watermark, shed.low_watermark) == (100, 100)
+
+
+def test_lane_scheduler_tenant_weights_reshape_refill():
+    from fishnet_tpu.sched.queue import LaneScheduler
+    from fishnet_tpu.resilience.shedding import LANE_THROUGHPUT
+
+    def pos(tenant, i):
+        return SimpleNamespace(
+            work=SimpleNamespace(id=tenant), position_id=i
+        )
+
+    def drain_order(weights):
+        sched = LaneScheduler(quantum=2)
+        for i in range(4):
+            sched.push(pos("a", i), "a", LANE_THROUGHPUT)
+            sched.push(pos("b", i), "b", LANE_THROUGHPUT)
+        sched.set_tenant_weights(weights)
+        assert sched.tenant_weights() == (weights or {})
+        return [sched.pop().work.id for _ in range(8)]
+
+    # Unweighted DRR: alternating turns of `quantum` positions.
+    assert drain_order(None) == ["a", "a", "b", "b"] * 2
+    # Weight 2.0 doubles a's refill; 0.5 would halve it (min 1).
+    assert drain_order({"a": 2.0}) == [
+        "a", "a", "a", "a", "b", "b", "b", "b",
+    ]
+    assert drain_order({"a": 0.5}) == [
+        "a", "b", "b", "a", "b", "b", "a", "a",
+    ]
+
+
+def test_standard_actuators_bind_fake_subsystems():
+    svc = SimpleNamespace(
+        set_coalesce_width=lambda v, shards=None: None,
+        coalesce_width=lambda: 4,
+        set_async_depth=lambda v: None,
+        async_depth=lambda: 2,
+        set_prefetch=lambda v, adaptive=True: None,
+    )
+    shed = SimpleNamespace(
+        high_watermark=256, low_watermark=128,
+        set_watermarks=lambda pair: None,
+    )
+    pool = SimpleNamespace(
+        leaf_width_max=lambda: 16, set_leaf_width_max=lambda v: None,
+    )
+    sched = SimpleNamespace(
+        set_tenant_weights=lambda w: None, tenant_weights=lambda: {},
+    )
+    acts = {a.name: a for a in standard_actuators(
+        service=svc, shed_policy=shed, mcts_pool=pool, scheduler=sched,
+    )}
+    assert set(acts) == {
+        "coalesce_width", "pipeline_depth", "prefetch_budget",
+        "shed_watermark", "mcts_leaf_max", "tenant_weights",
+    }
+    assert acts["coalesce_width"].shard_scoped
+    # Defaults are captured at BIND time — that is what revert and the
+    # escape hatch restore.
+    assert acts["pipeline_depth"].default == 2
+    assert acts["shed_watermark"].default == (256, 128)
+    assert acts["mcts_leaf_max"].default == 16
+    reg = ActuatorRegistry()
+    try:
+        reg.register_all(acts.values())
+        snap = reg.snapshot()
+        assert snap["coalesce_width"] == 4  # live getter, not default
+        assert snap["tenant_weights"] == {}
+    finally:
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet console --control panel
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_control_panel_renders_log():
+    from fishnet_tpu.telemetry.fleet import _control_panel
+
+    st = SimpleNamespace(profile=None, families={
+        "fishnet_control_actuations_total": _fam(
+            "fishnet_control_actuations_total",
+            [({"knob": "coalesce_width", "direction": "down"}, 3.0),
+             ({"knob": "pipeline_depth", "direction": "up"}, 2.0)],
+        ),
+        "fishnet_control_actuation_log": _fam(
+            "fishnet_control_actuation_log",
+            [({"seq": "2", "knob": "pipeline_depth", "direction": "up",
+               "to": "3"}, 12.0),
+             ({"seq": "1", "knob": "coalesce_width",
+               "direction": "down", "to": "2"}, 9.0)],
+            type="gauge",
+        ),
+    })
+    bare = SimpleNamespace(profile=None, families={})
+    lines = _control_panel([("w0", st), ("w1", bare)])
+    text = "\n".join(lines)
+    assert "w0" in text and "5 actuations" in text
+    # Log rows render oldest-first by per-proc actuation seq.
+    assert text.index("coalesce_width") < text.index("pipeline_depth")
+    assert "w9" in text and "-> 2" in text
+    assert "w1" in text and "control plane off" in text
+
+
+# ---------------------------------------------------------------------------
+# End to end against a real service
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live_service():
+    import time
+
+    from fishnet_tpu.nnue.weights import NnueWeights
+    from fishnet_tpu.search.service import SearchService
+
+    svc = SearchService(
+        weights=NnueWeights.random(seed=7), pool_slots=8,
+        batch_capacity=256, tt_bytes=8 << 20, backend="jax",
+        pipeline_depth=4, driver_threads=1,
+    )
+    try:
+        # Wait for the warmup dispatch probe to land: until it does
+        # the coalescer cannot recompute a width after an override
+        # clears, so the revert assertions below would be meaningless.
+        co = svc._coalescer
+        if co is not None:
+            deadline = time.monotonic() + 60.0
+            while co._probe is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+        yield svc
+    finally:
+        svc.close()
+
+
+def test_service_knob_seams(live_service):
+    svc = live_service
+    d0 = svc.async_depth()
+    if d0 is None:
+        pytest.skip("synchronous dispatch mode: no async depth knob")
+    svc.set_async_depth(4)
+    assert svc.async_depth() == 4
+    svc.set_async_depth(1)
+    assert svc.async_depth() == 1
+    svc.set_async_depth(None)  # None restores the static default
+    assert svc.async_depth() == d0
+
+    w0 = svc.coalesce_width()
+    if w0 is None:
+        pytest.skip("coalescing disabled: no width knob")
+    svc.set_coalesce_width(2)
+    assert svc.coalesce_width() == 2
+    svc.set_coalesce_width(None)
+    assert svc.coalesce_width() == w0
+
+
+def test_controller_end_to_end_on_real_service(live_service, monkeypatch):
+    """Injected transport latency shifts the critical path; the
+    controller probes the REAL service's coalesce width, and the
+    escape hatch + revert restore the pre-controller state exactly."""
+    svc = live_service
+    monkeypatch.delenv(NO_CONTROL_ENV, raising=False)
+    w0 = svc.coalesce_width()
+    d0 = svc.async_depth()
+    if w0 is None or d0 is None:
+        pytest.skip("coalescer or async pipeline disabled")
+
+    state = {"eval_steps": 0}
+
+    def fake_counters():
+        state["eval_steps"] += 40
+        return dict(state)
+
+    collector = SignalCollector(service=svc, counters_fn=fake_counters)
+    registry = ActuatorRegistry()
+    try:
+        registry.register_all([
+            a for a in standard_actuators(service=svc)
+            if a.name in ("coalesce_width", "pipeline_depth")
+        ])
+        policy = RuleProbePolicy()
+        policy.width_probe = LadderProbe(settle=1)
+        ctrl = Controller(collector, registry, policy=policy)
+
+        collector.feed("dispatch_issue", 0.050)
+        collector.feed("coalesce", 0.020)
+        applied = ctrl.step()
+        assert [a.knob for a in applied] == ["coalesce_width"]
+        # The probe's first move from w0 is deterministic: narrower
+        # when possible, else the bottom rung flips upward.
+        ref = LadderProbe(settle=1)
+        nxt, kind = ref.update(ref.index_of(w0), 40.0)
+        assert kind == "trial"
+        assert svc.coalesce_width() == WIDTH_LADDER[nxt]
+
+        # Escape hatch: decisions stop, revert restores w0 exactly.
+        monkeypatch.setenv(NO_CONTROL_ENV, "1")
+        collector.feed("dispatch_issue", 0.050)
+        assert ctrl.step() == []
+        registry.revert_all()
+        assert svc.coalesce_width() == w0
+        assert svc.async_depth() == d0
+    finally:
+        registry.close()
+        collector.detach()
