@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis analysis-fixtures sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-cache-smoke control-smoke fleet-obs-smoke mcts-smoke profile-smoke regress-smoke
+.PHONY: analysis analysis-fixtures sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-cache-smoke rpc-smoke control-smoke fleet-obs-smoke mcts-smoke profile-smoke regress-smoke
 
 # Project-invariant static checker (R1-R9); exit 0 = clean tree. The
 # JSON artifact feeds the CI annotation step (build.yml "analysis").
@@ -113,6 +113,17 @@ cluster-smoke:
 fleet-cache-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_position_tier.py -q \
 		-k "two_process or roundtrip or fallback"
+
+# Split-plane RPC transport contract (doc/disaggregation.md, ≤45 s):
+# ring wraparound + flow control, torn-record read-as-miss, stale-epoch
+# refusal after a frontend restart, evaluator-death demand timeout →
+# requeue not hang, the rpc.detach chaos site, the FISHNET_RPC=0
+# monolith escape hatch, and federation role labels. The `slow`
+# two-process real-service smoke stays out of this budget (tier-1
+# carries it via the full suite's slow lane).
+rpc-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_rpc.py -q \
+		-m "not slow"
 
 # Self-tuning control plane (doc/control-plane.md, ≤60 s): signal
 # folding + hysteresis, actuator bounds/revert and the

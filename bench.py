@@ -791,6 +791,22 @@ SUMMARY_SCHEMA = {
         "tier", "seconds", "jobs", "nodes_total", "evals_shipped",
         "nodes_per_eval", "postier", "chaos", "ledger", "drain",
     ),
+    # --split mode (keyed by mode == "split"): disaggregated serving
+    # (ISSUE 19) — N role="frontend" client processes share ONE
+    # role="evaluator" host over shared-memory rings, vs a control
+    # fleet of N monoliths. Headline: fused cross-process dispatch
+    # fill vs the per-process figure, gated alongside monolith/split
+    # analysis parity and the exactly-once fleet ledger through one
+    # frontend SIGKILL and one evaluator SIGKILL + restart
+    # (doc/disaggregation.md).
+    "split": (
+        "metric", "value", "unit", "mode", "nodes", "frontends",
+        "workload", "monolith", "split", "fill", "parity", "gates",
+        "ledger",
+    ),
+    "split.phase": (
+        "shape", "seconds", "jobs", "rpc", "chaos", "ledger", "drain",
+    ),
     # --control mode (keyed by mode == "control"): the self-tuning
     # control plane (ISSUE 18) A/B — the same two traffic mixes
     # (steady concurrent analysis vs bursty short best-move waves) run
@@ -821,7 +837,7 @@ SUMMARY_SCHEMA = {
 
 #: Every mode's summary carries the profiler section (validated below).
 for _mode_key in ("top", "overload", "multichip", "cache_replay",
-                  "mcts", "cluster", "fleet_cache", "control"):
+                  "mcts", "cluster", "fleet_cache", "control", "split"):
     SUMMARY_SCHEMA[_mode_key] = SUMMARY_SCHEMA[_mode_key] + ("profile",)
 
 
@@ -913,6 +929,19 @@ def validate_summary(summary: dict) -> None:
                 f"{ph}.{k}"
                 for k in SUMMARY_SCHEMA["fleet_cache.phase"]
                 if k not in sub
+            ]
+        if missing:
+            raise ValueError(f"bench summary missing keys: {missing}")
+        return
+    if summary.get("mode") == "split":
+        missing = [k for k in SUMMARY_SCHEMA["split"] if k not in summary]
+        for ph in ("monolith", "split"):
+            sub = summary.get(ph, {})
+            if not isinstance(sub, dict):
+                continue
+            missing += [
+                f"{ph}.{k}"
+                for k in SUMMARY_SCHEMA["split.phase"] if k not in sub
             ]
         if missing:
             raise ValueError(f"bench summary missing keys: {missing}")
@@ -1980,6 +2009,659 @@ def run_fleet_cache_bench(
                 "passed": True,
             },
             "ledger": on["ledger"],
+        }
+
+    return asyncio.run(drive())
+
+
+#: Split-mode knobs (env overridable): the disaggregated-serving
+#: benchmark (doc/disaggregation.md) — N device-free frontends, one
+#: evaluator host, shared-memory rings.
+SPLIT_FRONTENDS = int(_os.environ.get("FISHNET_SPLIT_FRONTENDS", 3))
+SPLIT_NODES = int(_os.environ.get("FISHNET_SPLIT_NODES", 220))
+SPLIT_OPENINGS = int(_os.environ.get("FISHNET_SPLIT_OPENINGS", 6))
+SPLIT_COPIES = int(_os.environ.get("FISHNET_SPLIT_COPIES", 3))
+SPLIT_PLY = int(_os.environ.get("FISHNET_SPLIT_PLY", 6))
+#: Supervisor monitor ticks (0.25 s each) before the seeded SIGKILLs in
+#: the split fleet phase: one frontend first, then the evaluator a few
+#: seconds later — mid-replay, with resubmit traffic in flight.
+SPLIT_FRONTEND_KILL_TICK = int(
+    _os.environ.get("FISHNET_SPLIT_FRONTEND_KILL_TICK", 16)
+)
+SPLIT_EVALUATOR_KILL_TICK = int(
+    _os.environ.get("FISHNET_SPLIT_EVALUATOR_KILL_TICK", 28)
+)
+SPLIT_DEADLINE_S = float(_os.environ.get("FISHNET_SPLIT_DEADLINE_S", 420.0))
+SPLIT_FILL_GATE = float(_os.environ.get("FISHNET_SPLIT_FILL_GATE", 0.75))
+#: MCTS fill probe shape: 5 trees x 8 fixed in-flight leaves bounds
+#: every per-frontend microbatch at 40 rows — 64 padded slots served
+#: alone (fill <= 0.63), while three frontends fused bound at 120 rows
+#: — one 128-slot dispatch (fill >= 0.75). The pow2 ladder is why
+#: fusing wins exactly when per-process fill sits under 2/3.
+SPLIT_FILL_TREES = int(_os.environ.get("FISHNET_SPLIT_FILL_TREES", 5))
+SPLIT_FILL_VISITS = int(_os.environ.get("FISHNET_SPLIT_FILL_VISITS", 240))
+
+
+def run_split_bench(
+    frontends: int = SPLIT_FRONTENDS,
+    nodes: int = SPLIT_NODES,
+) -> dict:
+    """Disaggregated-serving benchmark (ISSUE 19, doc/disaggregation.md):
+    ``frontends`` device-free ``role="frontend"`` client processes share
+    ONE ``role="evaluator"`` host over the shared-memory ring transport,
+    against a control fleet of the same count of self-contained
+    monoliths. Four claims, each gated:
+
+    * **ledger** — both fleet phases replay the same job set against the
+      fake server exactly-once; the split phase additionally takes one
+      frontend SIGKILL and one evaluator SIGKILL (+ supervisor restart)
+      mid-replay and must still drain clean with every job analysed.
+    * **cross-process fusion** — the evaluator's
+      ``fishnet_rpc_fused_rows_total`` / ``fused_slots_total`` prove
+      rows from different processes left in shared dispatches.
+    * **parity** — a controlled single-ordered probe in THIS process:
+      the same job prefixes through a monolith ``SearchService`` and
+      through ``RemoteBackend`` + in-process ``EvaluatorHost``, every
+      analysis field bit-identical (full tuples incl. depth/nodes/pv).
+      Controlled, not a diff of the fleet phases: which process wins an
+      acquire is a race and a long-lived process's TT makes fleet
+      replays diverge even monolith-vs-monolith (same reasoning as
+      run_fleet_cache_bench's parity leg).
+    * **fill** — the headline: an MCTS leaf-traffic probe (three
+      frontend drivers, fixed 8-leaf width, 5 trees each) measures
+      dispatch fill rows/slots. Served per-process the microbatches pad
+      ~40 rows into 64-slot buckets (~0.57); fused by one evaluator the
+      same rounds pad ~120 rows into 128-slot buckets — gated >=
+      SPLIT_FILL_GATE and > the per-process figure."""
+    import glob as _glob
+    import random
+    import tempfile
+    import urllib.request
+
+    from fishnet_tpu.chess import Board
+    from fishnet_tpu.cluster.supervisor import FleetSupervisor, ProcSpec
+    from fishnet_tpu.resilience.soak import _load_fake_server
+    from fishnet_tpu.utils.logger import Logger
+
+    fake = _load_fake_server()
+    startpos = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+    # Deterministic opening lines (seeded playouts), so both fleet
+    # phases and the parity probe replay byte-equal work.
+    opening_lines = []
+    for o in range(SPLIT_OPENINGS):
+        rng = random.Random(f"split-{o}")
+        while True:
+            board = Board(startpos)
+            moves = []
+            while len(moves) < SPLIT_PLY and board.outcome() == 0:
+                moves.append(rng.choice(board.legal_moves()))
+                board.push_uci(moves[-1])
+            if len(moves) == SPLIT_PLY:
+                break
+        opening_lines.append(moves)
+    jobs = [
+        (f"SPL{o:02d}c{c}", opening_lines[o])
+        for o in range(SPLIT_OPENINGS)
+        for c in range(SPLIT_COPIES)
+    ]
+
+    tmpdir = tempfile.mkdtemp(prefix="fishnet-split-")
+    nnue_path = _os.path.join(tmpdir, "material.npz")
+    material_weights().save(nnue_path)
+
+    def _parse_prom(text: str) -> dict:
+        out = {}
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            lhs, _, val = line.rpartition(" ")
+            if "{" in lhs:
+                name, _, rest = lhs.partition("{")
+                labels = tuple(sorted(
+                    p for p in rest.rstrip("}").split(",") if p
+                ))
+            else:
+                name, labels = lhs, ()
+            try:
+                out[(name, labels)] = float(val)
+            except ValueError:
+                continue
+        return out
+
+    class _RpcCounters:
+        """Accumulates fishnet_rpc_* exporter counters across process
+        incarnations (the evaluator gets SIGKILLed and restarted
+        mid-phase: a series going backwards banks the dead incarnation's
+        last-seen value — same discipline as run_fleet_cache_bench)."""
+
+        WANTED = frozenset((
+            "fishnet_rpc_submits_total", "fishnet_rpc_results_total",
+            "fishnet_rpc_fused_rows_total", "fishnet_rpc_fused_slots_total",
+            "fishnet_rpc_torn_total", "fishnet_rpc_stale_refusals_total",
+            "fishnet_rpc_reattach_total", "fishnet_rpc_detach_total",
+            "fishnet_rpc_resubmits_total",
+        ))
+
+        def __init__(self):
+            self._base = {}
+            self._last = {}
+
+        def poll(self, workdir: str) -> None:
+            for path in _glob.glob(_os.path.join(workdir, "*.port")):
+                proc = _os.path.splitext(_os.path.basename(path))[0]
+                try:
+                    port = int(open(path, encoding="utf-8").read().strip())
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=2.0
+                    ) as resp:
+                        text = resp.read().decode()
+                except (OSError, ValueError):
+                    continue  # mid-write port file or mid-restart child
+                for (name, labels), val in _parse_prom(text).items():
+                    if name not in self.WANTED:
+                        continue
+                    k = (proc, name, labels)
+                    prev = self._last.get(k, 0.0)
+                    if val < prev:
+                        self._base[k] = self._base.get(k, 0.0) + prev
+                    self._last[k] = val
+
+        def total(self, name: str, **labels) -> int:
+            want = {f'{k}="{v}"' for k, v in labels.items()}
+            tot = 0.0
+            for (proc, n, lbls), last in self._last.items():
+                if n == name and want <= set(lbls):
+                    tot += last + self._base.get((proc, n, lbls), 0.0)
+            return int(round(tot))
+
+    async def phase(split: bool) -> dict:
+        lichess = fake.FakeLichess(require_key=False)
+        lichess.reassign_after = 2.0
+        for wid, moves in jobs:
+            lichess.add_analysis_job(
+                moves=" ".join(moves), position=startpos, nodes=nodes,
+                work_id=wid,
+            )
+        # The supervisor owns the split env of its children; the parent
+        # must not leak an operator's FISHNET_RPC into the monolith
+        # phase (or into itself).
+        saved = {
+            k: _os.environ.get(k) for k in ("FISHNET_RPC", "FISHNET_RPC_DIR")
+        }
+        _os.environ.pop("FISHNET_RPC", None)
+        _os.environ.pop("FISHNET_RPC_DIR", None)
+        engine_args = ("--engine", "tpu-nnue", "--nnue-file", nnue_path)
+        try:
+            if split:
+                specs = [
+                    ProcSpec(
+                        name=f"F{i}",
+                        role="frontend",
+                        fault_spec=(
+                            f"seed=31;proc.kill:"
+                            f"nth={SPLIT_FRONTEND_KILL_TICK}:crash"
+                            if i == 1 else ""
+                        ),
+                        extra_args=engine_args,
+                    )
+                    for i in range(frontends)
+                ]
+                specs.append(ProcSpec(
+                    name="EVAL0",
+                    role="evaluator",
+                    fault_spec=(
+                        f"seed=33;proc.kill:"
+                        f"nth={SPLIT_EVALUATOR_KILL_TICK}:crash"
+                    ),
+                    extra_args=("--nnue-file", nnue_path),
+                ))
+            else:
+                specs = [
+                    ProcSpec(name=f"MONO{i}", extra_args=engine_args)
+                    for i in range(frontends)
+                ]
+            async with fake.FakeServer(lichess) as server:
+                supervisor = FleetSupervisor(
+                    server.endpoint,
+                    specs,
+                    logger=Logger(verbose=0),
+                    tick_seconds=0.25,
+                )
+                await supervisor.start()
+                tracker = _RpcCounters()
+                want_kills = {"F1", "EVAL0"} if split else set()
+                try:
+                    t0 = time.monotonic()
+                    while time.monotonic() - t0 < SPLIT_DEADLINE_S:
+                        await asyncio.sleep(0.5)
+                        await asyncio.to_thread(
+                            tracker.poll, str(supervisor.workdir)
+                        )
+                        killed = {
+                            n for _, n, k in supervisor.events if k == "kill"
+                        }
+                        if (want_kills <= killed
+                                and len(lichess.analyses) >= len(jobs)):
+                            break
+                    else:
+                        raise AssertionError(
+                            f"split {'split' if split else 'monolith'} "
+                            f"phase timed out: "
+                            f"{len(lichess.analyses)}/{len(jobs)} analyses "
+                            f"after {SPLIT_DEADLINE_S}s "
+                            f"(logs under {supervisor.workdir})"
+                        )
+                    # Final pre-drain scrape: children are idle-polling,
+                    # every counter is at its terminal value.
+                    await asyncio.to_thread(
+                        tracker.poll, str(supervisor.workdir)
+                    )
+                    exit_codes = await supervisor.drain()
+                except BaseException:
+                    await supervisor.kill_all()
+                    raise
+                measured = round(time.monotonic() - t0, 2)
+                fleet = lichess.fleet_report()
+                events = [(n, k) for _, n, k in supervisor.events]
+                if not fleet["clean"]:
+                    raise AssertionError(f"fleet ledger dirty: {fleet}")
+                if len(lichess.analyses) != len(jobs):
+                    raise AssertionError(
+                        f"{len(lichess.analyses)}/{len(jobs)} jobs analysed"
+                    )
+                bad = {n: rc for n, rc in exit_codes.items() if rc != 0}
+                if bad:
+                    raise AssertionError(
+                        f"fleet drain exited nonzero: {bad} "
+                        f"(logs under {supervisor.workdir})"
+                    )
+                rpc = {
+                    "submits": tracker.total(
+                        "fishnet_rpc_submits_total", family="nnue"
+                    ),
+                    "results": tracker.total(
+                        "fishnet_rpc_results_total", family="nnue"
+                    ),
+                    "fused_rows": tracker.total(
+                        "fishnet_rpc_fused_rows_total", family="nnue"
+                    ),
+                    "fused_slots": tracker.total(
+                        "fishnet_rpc_fused_slots_total", family="nnue"
+                    ),
+                    "resubmits": tracker.total(
+                        "fishnet_rpc_resubmits_total"
+                    ),
+                    "stale_refusals": tracker.total(
+                        "fishnet_rpc_stale_refusals_total"
+                    ),
+                    "reattaches": tracker.total(
+                        "fishnet_rpc_reattach_total"
+                    ),
+                    "torn": tracker.total("fishnet_rpc_torn_total"),
+                }
+                if split:
+                    for name in ("F1", "EVAL0"):
+                        if (name, "kill") not in events:
+                            raise AssertionError(
+                                f"no SIGKILL landed on {name}: {events}"
+                            )
+                    if supervisor.restarts_total() < 2:
+                        raise AssertionError(
+                            f"expected >=2 restarts (killed frontend + "
+                            f"evaluator), got "
+                            f"{supervisor.restarts_total()}: {events}"
+                        )
+                    if rpc["fused_rows"] < 1 or rpc["results"] < 1:
+                        raise AssertionError(
+                            f"split phase served no ring traffic: {rpc}"
+                        )
+                    # The evaluator restart re-attached every surviving
+                    # frontend link (attach.host counts into
+                    # fishnet_rpc_reattach_total).
+                    if rpc["reattaches"] < frontends + 1:
+                        raise AssertionError(
+                            f"evaluator restart did not re-attach the "
+                            f"fleet's links: {rpc}"
+                        )
+                elif rpc["submits"] or rpc["results"]:
+                    raise AssertionError(
+                        f"monolith phase touched the ring transport: {rpc}"
+                    )
+                log(
+                    f"bench: split {'split' if split else 'monolith'} "
+                    f"fleet phase done in {measured}s — "
+                    f"{len(lichess.analyses)} analyses, rpc {rpc}, "
+                    f"restarts {supervisor.restarts_total()}"
+                )
+                return {
+                    "shape": (
+                        f"{frontends}x frontend + 1 evaluator" if split
+                        else f"{frontends}x monolith"
+                    ),
+                    "seconds": measured,
+                    "jobs": len(jobs),
+                    "rpc": rpc,
+                    "chaos": {
+                        "kills": sum(1 for _, k in events if k == "kill"),
+                        "restarts": supervisor.restarts_total(),
+                        "events": [list(e) for e in supervisor.events],
+                    },
+                    "ledger": fleet,
+                    "drain": {"exit_codes": exit_codes, "all_zero": not bad},
+                }
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    _os.environ.pop(k, None)
+                else:
+                    _os.environ[k] = v
+
+    async def parity_probe() -> dict:
+        """Monolith SearchService vs RemoteBackend + in-process
+        EvaluatorHost, one fixed order, cold caches, the same weights:
+        the ONLY variable is whether evals cross the ring transport."""
+        import jax
+
+        from fishnet_tpu.nnue.jax_eval import params_from_weights
+        from fishnet_tpu.nnue.weights import NnueWeights
+        from fishnet_tpu.rpc.client import RemoteBackend
+        from fishnet_tpu.rpc.host import EvaluatorHost
+        from fishnet_tpu.search import eval_cache as _ec
+        from fishnet_tpu.search.service import SearchService
+
+        w = NnueWeights.load(nnue_path)
+        # psqt_path is pinned to the host-material rung because that is
+        # what RemoteBackend forces (doc/disaggregation.md) — the ladder
+        # contract makes every rung bit-identical anyway, this just
+        # keeps both legs on the same one.
+        common = dict(
+            weights=w, net_path=nnue_path, pool_slots=8,
+            batch_capacity=256, tt_bytes=8 << 20, backend="jax",
+            psqt_path="host-material", pipeline_depth=2, driver_threads=1,
+        )
+        saved = _os.environ.get("FISHNET_NO_EVAL_CACHE")
+        _os.environ["FISHNET_NO_EVAL_CACHE"] = "1"
+
+        async def leg(svc):
+            svc.set_prefetch(0, adaptive=False)
+            out = []
+            try:
+                for moves in opening_lines:
+                    for k in (0, len(moves) // 2, len(moves)):
+                        r = await svc.search(
+                            root_fen=startpos, moves=moves[:k],
+                            nodes=nodes, depth=0, multipv=2,
+                        )
+                        out.append((
+                            r.best_move, r.depth, r.nodes,
+                            tuple(
+                                (l.multipv, l.depth, l.is_mate, l.value,
+                                 tuple(l.pv))
+                                for l in r.lines
+                            ),
+                        ))
+            finally:
+                svc.close()
+            return out
+
+        try:
+            _ec.reset_cache()
+            mono_out = await leg(SearchService(**common))
+
+            _ec.reset_cache()
+            rpc_dir = _os.path.join(tmpdir, "parity-rpc")
+            host = EvaluatorHost(
+                nnue_params=jax.device_put(params_from_weights(w)),
+                rpc_dir=rpc_dir,
+            )
+            host.start()
+            try:
+                split_out = await leg(RemoteBackend(rpc_dir=rpc_dir, **common))
+            finally:
+                host.close()
+        finally:
+            if saved is None:
+                _os.environ.pop("FISHNET_NO_EVAL_CACHE", None)
+            else:
+                _os.environ["FISHNET_NO_EVAL_CACHE"] = saved
+            _ec.reset_cache()
+
+        if mono_out != split_out:
+            diff = [
+                i for i, (a, b) in enumerate(zip(mono_out, split_out))
+                if a != b
+            ]
+            raise AssertionError(
+                f"monolith vs split analyses diverged at positions "
+                f"{diff[:4]} ({len(diff)} of {len(mono_out)}): "
+                f"mono={mono_out[diff[0]]} split={split_out[diff[0]]}"
+            )
+        return {
+            "identical": True,
+            "positions_compared": len(mono_out),
+            "method": (
+                "single-ordered replay in one process: monolith "
+                "SearchService vs RemoteBackend + in-process "
+                "EvaluatorHost, cold caches, same weights file; full "
+                "analysis tuples incl. depth/nodes/pv"
+            ),
+        }
+
+    def fill_probe() -> dict:
+        """MCTS leaf traffic, per-process vs fused. The per-process leg
+        runs ONE pool on the local shared plane (all three frontends are
+        deterministic clones, so one measurement covers them); the
+        fused leg runs three frontend driver threads, each its own pool
+        over RemoteAzPlane, into ONE EvaluatorHost. A round barrier
+        releases the three submits together — steady-state co-arrival,
+        which is the operating point disaggregation exists for."""
+        import jax
+
+        from fishnet_tpu.models.az import init_az_params
+        from fishnet_tpu.rpc import rings
+        from fishnet_tpu.rpc.client import RemoteAzPlane
+        from fishnet_tpu.rpc.host import EvaluatorHost
+        from fishnet_tpu.search import eval_cache as _ec
+        from fishnet_tpu.search.mcts import MctsConfig, MctsPool
+
+        # Fixed 8-leaf width, no memo/reuse/cache: every round reaches
+        # the dispatch plane with a full-demand microbatch, bounded at
+        # trees x 8 rows (see SPLIT_FILL_TREES above for the pow2
+        # arithmetic the gate rides on).
+        cfg = MctsConfig(
+            batch_capacity=256, leaves_per_step=8, adaptive_leaves=False,
+            expansion_memo=0, tree_reuse=False,
+        )
+        params = jax.device_put(init_az_params(jax.random.PRNGKey(0), cfg.az))
+        saved = _os.environ.get("FISHNET_NO_EVAL_CACHE")
+        _os.environ["FISHNET_NO_EVAL_CACHE"] = "1"
+
+        def run_pool(pool):
+            for i in range(SPLIT_FILL_TREES):
+                pool.submit(
+                    startpos, list(MCTS_OPENINGS[i % len(MCTS_OPENINGS)]),
+                    SPLIT_FILL_VISITS,
+                )
+            while pool.active() > 0:
+                pool.step()
+
+        def snap_dispatch(pool):
+            d = pool.counters().get("dispatch") or {}
+            return (d.get("rows_dispatched", 0), d.get("slots_dispatched", 0))
+
+        try:
+            # -- per-process leg: one pool, local shared plane --------
+            _ec.reset_cache()
+            pool = MctsPool(params, cfg)
+            pool.warmup()
+            r0, s0 = snap_dispatch(pool)
+            run_pool(pool)
+            r1, s1 = snap_dispatch(pool)
+            pool.close()
+            mono_rows, mono_slots = r1 - r0, s1 - s0
+            fill_mono = mono_rows / max(1, mono_slots)
+
+            # -- fused leg: three driver threads, one evaluator host --
+            _ec.reset_cache()
+            rpc_dir = _os.path.join(tmpdir, "fill-rpc")
+            host = EvaluatorHost(
+                az_params=params, az_cfg=cfg, rpc_dir=rpc_dir, poll_s=0.05,
+            )
+            host.start()
+            barrier = threading.Barrier(frontends)
+
+            class _SyncedPlane:
+                """RemoteAzPlane + the round barrier (lane API passthrough)."""
+
+                def __init__(self, inner):
+                    self._inner = inner
+
+                def register_lane(self):
+                    return self._inner.register_lane()
+
+                def warmup(self):
+                    self._inner.warmup()
+
+                def evaluate(self, lane, planes_u8, n, keys=None):
+                    try:
+                        barrier.wait(timeout=60.0)
+                    except threading.BrokenBarrierError:
+                        pass  # a sibling finished/failed; degrade unsynced
+                    return self._inner.evaluate(lane, planes_u8, n, keys)
+
+                def counters(self):
+                    return self._inner.counters()
+
+                def close(self):
+                    self._inner.close()
+
+            before = rings.stats()
+            errors = []
+
+            def drive_frontend(idx):
+                try:
+                    # Same-process frontends need distinct link names;
+                    # the per-pid default would collide and fence peers.
+                    plane = RemoteAzPlane(
+                        cfg, rpc_dir=rpc_dir,
+                        link_name=f"fill-{idx}.ring",
+                    )
+                    p = MctsPool(params, cfg, evaluator=_SyncedPlane(plane))
+                    try:
+                        run_pool(p)
+                    finally:
+                        p.close()
+                        plane.close()
+                except BaseException as exc:  # surfaced below
+                    errors.append(exc)
+                    barrier.abort()
+
+            threads = [
+                threading.Thread(
+                    target=drive_frontend, args=(i,), daemon=True
+                )
+                for i in range(frontends)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=SPLIT_DEADLINE_S)
+            host.close()
+            if errors:
+                raise errors[0]
+            after = rings.stats()
+            fused_rows = after.get("fused.rows.az", 0) - before.get(
+                "fused.rows.az", 0
+            )
+            fused_slots = after.get("fused.slots.az", 0) - before.get(
+                "fused.slots.az", 0
+            )
+            fill_split = fused_rows / max(1, fused_slots)
+        finally:
+            if saved is None:
+                _os.environ.pop("FISHNET_NO_EVAL_CACHE", None)
+            else:
+                _os.environ["FISHNET_NO_EVAL_CACHE"] = saved
+            _ec.reset_cache()
+
+        log(
+            f"bench: split fill probe — per-process "
+            f"{mono_rows}/{mono_slots} = {round(fill_mono, 4)}, fused "
+            f"{fused_rows}/{fused_slots} = {round(fill_split, 4)}"
+        )
+        return {
+            "monolith_per_process": round(fill_mono, 4),
+            "split_fused": round(fill_split, 4),
+            "monolith_rows": int(mono_rows),
+            "monolith_slots": int(mono_slots),
+            "fused_rows": int(fused_rows),
+            "fused_slots": int(fused_slots),
+            "trees_per_frontend": SPLIT_FILL_TREES,
+            "visits": SPLIT_FILL_VISITS,
+            "leaves_per_step": cfg.leaves_per_step,
+            "method": (
+                "MCTS leaf traffic, fixed 8-leaf width, memo/reuse/cache "
+                "off: one pool on the local plane (per-process figure) "
+                "vs three synchronized frontend drivers over "
+                "RemoteAzPlane into one EvaluatorHost (fused figure); "
+                "fill = dispatched rows / padded bucket slots"
+            ),
+        }
+
+    async def drive() -> dict:
+        log(
+            f"bench: split phase 1/4 — {frontends}x monolith control "
+            f"fleet, {len(jobs)} jobs..."
+        )
+        mono = await phase(split=False)
+        log(
+            f"bench: split phase 2/4 — {frontends}x frontend + 1 "
+            f"evaluator, SIGKILL F1 at tick {SPLIT_FRONTEND_KILL_TICK} "
+            f"and EVAL0 at tick {SPLIT_EVALUATOR_KILL_TICK}..."
+        )
+        split = await phase(split=True)
+        log("bench: split phase 3/4 — monolith vs split parity probe...")
+        parity = await parity_probe()
+        log("bench: split phase 4/4 — MCTS fused-fill probe...")
+        fill = await asyncio.to_thread(fill_probe)
+
+        if fill["split_fused"] < SPLIT_FILL_GATE:
+            raise AssertionError(
+                f"fused fill {fill['split_fused']} < {SPLIT_FILL_GATE}: "
+                f"{fill}"
+            )
+        if fill["split_fused"] <= fill["monolith_per_process"]:
+            raise AssertionError(
+                f"fused fill {fill['split_fused']} did not beat the "
+                f"per-process fill {fill['monolith_per_process']}: {fill}"
+            )
+
+        return {
+            "metric": "split_fused_dispatch_fill",
+            "value": fill["split_fused"],
+            "unit": "ratio",
+            "mode": "split",
+            "profile": profile_section(),
+            "nodes": nodes,
+            "frontends": frontends,
+            "workload": {
+                "openings": SPLIT_OPENINGS,
+                "copies": SPLIT_COPIES,
+                "ply": SPLIT_PLY,
+                "jobs": len(jobs),
+                "positions_per_job": SPLIT_PLY + 1,
+            },
+            "monolith": mono,
+            "split": split,
+            "fill": fill,
+            "parity": parity,
+            "gates": {
+                "fill_min": SPLIT_FILL_GATE,
+                "fused_gt_monolith": True,
+                "passed": True,
+            },
+            "ledger": split["ledger"],
         }
 
     return asyncio.run(drive())
@@ -3235,6 +3917,16 @@ def main(argv=None) -> None:
         "run_fleet_cache_bench)",
     )
     parser.add_argument(
+        "--split", action="store_true",
+        help="run the disaggregated-serving benchmark instead of the "
+        "throughput tiers: N role=frontend client processes sharing one "
+        "role=evaluator host over shared-memory rings vs N monoliths, "
+        "gating cross-process fused dispatch fill, monolith/split "
+        "analysis parity, and the exactly-once fleet ledger through a "
+        "frontend SIGKILL and an evaluator SIGKILL + restart (see "
+        "run_split_bench)",
+    )
+    parser.add_argument(
         "--control", action="store_true",
         help="run the self-tuning control-plane A/B instead of the "
         "throughput tiers: two traffic mixes (steady analysis, bursty "
@@ -3282,6 +3974,17 @@ def main(argv=None) -> None:
             f"visits, {MCTS_WARM_ROUNDS} warm rounds..."
         )
         summary = run_mcts_bench()
+        emit_summary(summary, args.json_out)
+        return
+
+    if args.split:
+        log(
+            f"bench: split mode — {SPLIT_FRONTENDS} frontends + 1 "
+            f"evaluator vs {SPLIT_FRONTENDS} monoliths, "
+            f"{SPLIT_OPENINGS}x{SPLIT_COPIES} jobs, SIGKILLs "
+            "mid-replay + parity + fused-fill probes..."
+        )
+        summary = run_split_bench()
         emit_summary(summary, args.json_out)
         return
 

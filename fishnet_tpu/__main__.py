@@ -172,6 +172,28 @@ def build_search_service(opt: Opt, logger: Logger, psqt_path=None):
         logger.warn("No --nnue-file given; using random NNUE weights (dev mode).")
         weights = NnueWeights.random(seed=0)
 
+    # Split plane (FISHNET_RPC=1, doc/disaggregation.md): this process
+    # is a FRONTEND — no local evaluator, no dispatch probe; every eval
+    # microbatch rides the shared-memory ring to the evaluator host.
+    # Unset/0 falls through to the monolithic build below byte-for-byte.
+    from fishnet_tpu.rpc import rpc_enabled
+
+    if rpc_enabled():
+        from fishnet_tpu.rpc.client import RemoteBackend
+
+        logger.info(
+            "FISHNET_RPC=1: frontend role — eval traffic rides the "
+            "shared-memory ring transport to the evaluator host."
+        )
+        return RemoteBackend(
+            weights=weights,
+            net_path=opt.nnue_file,
+            batch_capacity=opt.resolved_microbatch(),
+            pipeline_depth=opt.pipeline or 2,
+            driver_threads=opt.resolved_search_threads(),
+            psqt_path=psqt_path,
+        )
+
     evaluator = build_sharded_evaluator(opt, weights, logger)
     mesh_devices = resolve_mesh_devices(opt, evaluator, logger)
 

@@ -99,6 +99,17 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/observability.md", "tests/test_profiler.py"),
     Knob("FISHNET_PROFILE_HZ", "env", "29 (samples/second)",
          "doc/observability.md"),
+    Knob("FISHNET_RPC", "env", "unset (monolith)",
+         "doc/disaggregation.md", "tests/test_rpc.py"),
+    Knob("FISHNET_RPC_DIR", "env",
+         "fishnet-rpc-<uid> in the system tempdir",
+         "doc/disaggregation.md", "tests/test_rpc.py"),
+    Knob("FISHNET_RPC_RING_SLOTS", "env", "8 slots per ring",
+         "doc/disaggregation.md", "tests/test_rpc.py"),
+    Knob("FISHNET_RPC_SLOT_BYTES", "env", "4 MiB per slot",
+         "doc/disaggregation.md", "tests/test_rpc.py"),
+    Knob("FISHNET_RPC_TIMEOUT", "env", "120 (seconds)",
+         "doc/disaggregation.md", "tests/test_rpc.py"),
     Knob("FISHNET_SHARD_PLACEMENT", "env", "auto (round-robin groups)",
          "doc/sharding.md"),
     Knob("FISHNET_SPANS_DIR", "env", "unset (system tempdir)",
@@ -162,6 +173,8 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/observability.md"),
     Knob("--spans-journal", "cli", "unset (ring dumps only)",
          "doc/observability.md"),
+    Knob("--split", "cli", "off (bench.py mode flag)",
+         "doc/disaggregation.md", "tests/test_rpc.py"),
     Knob("--stats-file", "cli", "platform data dir", "doc/install.md",
          "tests/test_configure.py"),
     Knob("--system-backlog", "cli", "0s", "doc/install.md"),
@@ -173,6 +186,9 @@ KNOBS: Tuple[Knob, ...] = (
          "tests/test_configure.py"),
     Knob("--verbose", "cli", "off", "doc/install.md",
          "tests/test_configure.py"),
+    # -- supervisor spec fields (cluster/supervisor.py ProcSpec) -----------
+    Knob("role=", "cli", "monolith (frontend|evaluator split the plane)",
+         "doc/disaggregation.md", "tests/test_rpc.py"),
     # -- fishnet.ini keys (mirror of _INI_FIELDS in configure.py) ----------
     Knob("Endpoint", "ini", "https://lichess.org/fishnet",
          "doc/install.md", "tests/test_configure.py"),

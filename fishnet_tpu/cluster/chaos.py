@@ -22,6 +22,13 @@ ends with a fleet-wide drain, the fleet-ledger audit (0 lost, 0
 duplicated, kills reassigned across processes) and a ``/metrics``
 scrape asserting the fleet metric families. Everything chaotic comes
 from the fault-plan grammar, so a failing run replays exactly.
+
+Split-topology scenarios (``ProcSpec(role="frontend"|"evaluator")``,
+doc/disaggregation.md) script with the same one-string-per-proc
+grammar: give the evaluator spec ``rpc.detach:nth=N:error`` and its
+host drops one frontend link mid-flight on its Nth service sweep — the
+frontend reattaches and resubmits, exactly-once audited like every
+other fault here (exercised by ``bench.py --split``).
 """
 
 from __future__ import annotations

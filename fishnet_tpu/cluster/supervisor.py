@@ -63,6 +63,14 @@ class ProcSpec:
     protocol POST body carries ``fishnet.apikey``, so the fake server's
     fleet ledger attributes handouts and completions per-process
     without any header rewriting in the proxy.
+
+    ``role`` selects the split-plane shape (doc/disaggregation.md):
+    ``monolith`` (default) is today's self-contained client;
+    ``role=frontend`` runs the same client with ``FISHNET_RPC=1`` so
+    its eval traffic rides the ring transport; ``role=evaluator`` runs
+    ``python -m fishnet_tpu.rpc.host`` serving every frontend's link in
+    the supervisor's ``rpc_dir``. All three ride the same chaos
+    proxies, restart budgets, drain, and metrics discovery.
     """
 
     name: str
@@ -70,6 +78,14 @@ class ProcSpec:
     fault_spec: str = ""  # proxy.* + proc.* plan for THIS process
     extra_args: Tuple[str, ...] = ()
     restart_budget: int = 3
+    role: str = "monolith"  # monolith | frontend | evaluator
+
+    def __post_init__(self) -> None:
+        if self.role not in ("monolith", "frontend", "evaluator"):
+            raise ValueError(
+                f"ProcSpec role must be monolith|frontend|evaluator, "
+                f"got {self.role!r}"
+            )
 
 
 @dataclass
@@ -101,12 +117,16 @@ class FleetSupervisor:
         drain_deadline: float = 5.0,
         restart_backoff: float = 0.4,
         metrics: bool = True,
+        rpc_dir: Optional[str] = None,
     ) -> None:
         self.server_endpoint = server_endpoint
         self.specs = list(specs)
         self.workdir = Path(workdir) if workdir else Path(
             tempfile.mkdtemp(prefix="fishnet-fleet-")
         )
+        # Link-file directory for split-role specs (frontend/evaluator);
+        # monolith-only fleets never touch it.
+        self.rpc_dir = rpc_dir or str(self.workdir / "rpc")
         self.logger = logger
         self.tick_seconds = tick_seconds
         self.drain_deadline = drain_deadline
@@ -151,30 +171,45 @@ class FleetSupervisor:
 
     async def _spawn(self, handle: ProcHandle) -> None:
         spec = handle.spec
-        cmd = [
-            sys.executable, "-m", "fishnet_tpu", "run",
-            "--no-conf", "--no-stats-file",
-            "--engine", "mock",
-            "--endpoint", handle.proxy.endpoint,
-            "--key", spec.key or spec.name,
-            "--cores", "1",
-            "--max-backoff", "1s",
-            "--drain-deadline", f"{int(self.drain_deadline * 1000)}ms",
-            *spec.extra_args,
-        ]
-        if self.metrics:
-            cmd += [
-                "--metrics-port", "0",
-                "--metrics-port-file",
-                str(self.workdir / f"{spec.name}.port"),
-                # Batch-span write-ahead: spans recorded after the
-                # aggregator's last scrape survive a SIGKILL, so the
-                # fleet stitcher can join the dead incarnation's
-                # reassigned unit cross-process. Restarts append a new
-                # incarnation header to the same file.
-                "--spans-journal",
-                str(self.workdir / f"{spec.name}.journal.jsonl"),
+        if spec.role == "evaluator":
+            # Device-holding half of the split plane: serves every
+            # frontend link in rpc_dir; no lichess client underneath.
+            cmd = [
+                sys.executable, "-m", "fishnet_tpu.rpc.host",
+                "--dir", self.rpc_dir,
+                *spec.extra_args,
             ]
+            if self.metrics:
+                cmd += [
+                    "--metrics-port", "0",
+                    "--metrics-port-file",
+                    str(self.workdir / f"{spec.name}.port"),
+                ]
+        else:
+            cmd = [
+                sys.executable, "-m", "fishnet_tpu", "run",
+                "--no-conf", "--no-stats-file",
+                "--engine", "mock",
+                "--endpoint", handle.proxy.endpoint,
+                "--key", spec.key or spec.name,
+                "--cores", "1",
+                "--max-backoff", "1s",
+                "--drain-deadline", f"{int(self.drain_deadline * 1000)}ms",
+                *spec.extra_args,
+            ]
+            if self.metrics:
+                cmd += [
+                    "--metrics-port", "0",
+                    "--metrics-port-file",
+                    str(self.workdir / f"{spec.name}.port"),
+                    # Batch-span write-ahead: spans recorded after the
+                    # aggregator's last scrape survive a SIGKILL, so the
+                    # fleet stitcher can join the dead incarnation's
+                    # reassigned unit cross-process. Restarts append a
+                    # new incarnation header to the same file.
+                    "--spans-journal",
+                    str(self.workdir / f"{spec.name}.journal.jsonl"),
+                ]
         env = dict(os.environ)
         existing = env.get("PYTHONPATH")
         env["PYTHONPATH"] = (
@@ -184,6 +219,22 @@ class FleetSupervisor:
         # Chaos lives at the proxy and this supervisor; the child runs
         # a clean, production-shaped client.
         env.pop(PLAN_ENV, None)
+        # Role plumbing: a frontend is the SAME client binary with the
+        # rpc gate flipped; a monolith must never inherit a split env
+        # from the operator's shell.
+        if spec.role == "frontend":
+            env["FISHNET_RPC"] = "1"
+            env["FISHNET_RPC_DIR"] = self.rpc_dir
+        else:
+            env.pop("FISHNET_RPC", None)
+            if spec.role == "evaluator":
+                env["FISHNET_RPC_DIR"] = self.rpc_dir
+                # The host polls rpc.detach from ITS OWN plan env (the
+                # proxy sites are meaningless to it).
+                if spec.fault_spec:
+                    env[PLAN_ENV] = spec.fault_spec
+            else:
+                env.pop("FISHNET_RPC_DIR", None)
         logf = open(handle.log_path, "ab")
         try:
             handle.process = await asyncio.create_subprocess_exec(

@@ -15,7 +15,7 @@ Plan grammar (also doc/resilience.md)::
     site    := net.acquire | net.submit | engine.spawn
              | service.device_step | queue.schedule | queue.admit
              | proxy.partition | proxy.latency | proxy.error5xx
-             | proc.kill | proc.sigterm
+             | proc.kill | proc.sigterm | rpc.detach
     trigger := 'nth=' N | 'nth=' A '..' B     -- 1-based call index
              | 'every=' N                     -- every Nth call
              | 'p=' FLOAT                     -- per-call probability
@@ -36,7 +36,11 @@ connection reset, no HTTP response — for a window of S seconds; action
 ``error`` drops just the matched request) once per forwarded request;
 the fleet supervisor polls ``proc.kill:T:crash`` (SIGKILL) and
 ``proc.sigterm:T:error`` (SIGTERM → graceful drain) once per monitor
-tick per process, so ``nth=N`` means that process's Nth tick.
+tick per process, so ``nth=N`` means that process's Nth tick; the
+split-plane evaluator host (fishnet_tpu/rpc/host.py) polls
+``rpc.detach:T:error`` once per service sweep WITH at least one link
+attached, dropping one frontend link mid-flight (the next sweep
+re-attaches it and the host-epoch bump makes the frontend resubmit).
 
 Determinism: ``nth``/``every`` triggers depend only on the per-site
 call count; ``p`` triggers draw from the plan's own seeded RNG, so a
@@ -83,6 +87,7 @@ SITES = (
     "proxy.error5xx",
     "proc.kill",
     "proc.sigterm",
+    "rpc.detach",
 )
 
 ACTIONS = ("error", "crash", "latency", "hang")

@@ -1,0 +1,21 @@
+"""Disaggregated serving: N search frontends, one evaluator mesh.
+
+The reference deployment is a fleet of monoliths — every client process
+owns its engine pool AND a device-holding evaluator. This package
+splits that plane (doc/disaggregation.md): cheap protocol/search
+frontends submit position microbatches over a shared-memory ring
+transport (:mod:`~fishnet_tpu.rpc.rings`) to one evaluator process
+(:mod:`~fishnet_tpu.rpc.host`) that drains every attached frontend's
+ring into ONE process-local dispatch coalescer — batches from different
+processes fuse into the same segmented device dispatches, which is the
+direct fix for per-process batch fill.
+
+The client shim (:mod:`~fishnet_tpu.rpc.client`) is byte-compatible
+with the in-process seam: ``RemoteBackend`` IS a ``SearchService``
+whose evaluator ships microbatches over the wire, and ``RemoteAzPlane``
+implements the AZ dispatch-plane lane API, so alpha-beta drivers and
+MCTS leaf traffic ride unchanged. ``FISHNET_RPC`` unset or ``0`` keeps
+the monolithic path byte-for-byte.
+"""
+
+from fishnet_tpu.rpc.rings import rpc_enabled  # noqa: F401

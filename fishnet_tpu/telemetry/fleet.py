@@ -606,6 +606,43 @@ def _control_panel(procs) -> List[str]:
     return lines
 
 
+def _role_of(st: _ProcState) -> str:
+    """The proc's split-plane role from its ``fishnet_rpc_role`` gauge
+    (doc/disaggregation.md); a monolith exposes no rpc family at all."""
+    fam = st.families.get("fishnet_rpc_role")
+    if fam is not None:
+        for s in fam.samples:
+            if s.value:
+                return s.labels.get("role", "?")
+    return "mono"
+
+
+def _ring_panel(procs) -> List[str]:
+    """Per-link ring-depth view for split fleets: every attached link's
+    submit/result queue depth as the owning proc reports it
+    (``fishnet_rpc_ring_depth``). Only rendered when some proc exposes
+    the family — a monolith fleet keeps its console unchanged."""
+    rows: List[str] = []
+    for name, st in procs:
+        fam = st.families.get("fishnet_rpc_ring_depth")
+        if fam is None:
+            continue
+        depths: Dict[str, Dict[str, float]] = {}
+        for s in fam.samples:
+            link = s.labels.get("link", "?")
+            depths.setdefault(link, {})[s.labels.get("ring", "?")] = s.value
+        for link in sorted(depths):
+            d = depths[link]
+            rows.append(
+                f"{name:<10} {link:<24} "
+                f"submit {d.get('submit', 0.0):>4.0f}  "
+                f"result {d.get('result', 0.0):>4.0f}"
+            )
+    if not rows:
+        return []
+    return ["", "RPC LINKS (ring depth per link)"] + rows
+
+
 def render_console(
     agg: FleetAggregator, profiles: bool = False, control: bool = False
 ) -> str:
@@ -622,8 +659,9 @@ def render_console(
             f"poll #{agg._polls}  {time.strftime('%H:%M:%S', time.localtime(now))}"
         )
         lines.append(
-            f"{'PROC':<10} {'UP':<3} {'AGE':>6} {'PIDS':>4} {'REQS':>7} "
-            f"{'LANES':>5} {'SHED':>4} {'DRAIN':>5} {'BRKR':>4} {'ACQ_P99':>8}"
+            f"{'PROC':<10} {'UP':<3} {'ROLE':<9} {'AGE':>6} {'PIDS':>4} "
+            f"{'REQS':>7} {'LANES':>5} {'SHED':>4} {'DRAIN':>5} {'BRKR':>4} "
+            f"{'ACQ_P99':>8}"
         )
         for name, st in procs:
             reqs = _sum_samples(st, "fishnet_api_requests_total")
@@ -642,6 +680,7 @@ def render_console(
                     p99 = max(r["p99"] for r in rows if r["p99"] is not None)
             lines.append(
                 f"{name:<10} {'y' if st.up else 'N':<3} "
+                f"{_role_of(st):<9} "
                 f"{st.age_s(now):>5.1f}s {len(st.incarnations):>4} "
                 f"{_fmt(reqs):>7} {_fmt(lanes):>5} {_fmt(shed):>4} "
                 f"{_fmt(drain):>5} {_fmt(brkr):>4} "
@@ -650,6 +689,7 @@ def render_console(
             if not st.up and st.last_error:
                 lines.append(f"  !! {name}: {st.last_error}")
         slo_rows = agg.slo.evaluate(now)
+        lines.extend(_ring_panel(procs))
         if profiles:
             lines.extend(_profile_panel(procs))
         if control:

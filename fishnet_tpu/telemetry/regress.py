@@ -141,6 +141,19 @@ SERIES_SPECS: Tuple[Spec, ...] = (
          0.0, "gate"),
     Spec("FLEETCACHE", "gates_passed", "gates.passed", "true", 0.0,
          "gate"),
+    # -- SPLIT (disaggregated serving; bench.py --split) -----------------
+    # Headline = fused cross-process dispatch fill; parity and the
+    # exactly-once ledger (through the frontend + evaluator SIGKILLs)
+    # are hard gates, ring volume only watched (workload-shaped).
+    Spec("SPLIT", "fused_dispatch_fill", "value", "up", 0.15, "gate"),
+    Spec("SPLIT", "parity_identical", "parity.identical", "true", 0.0,
+         "gate"),
+    Spec("SPLIT", "ledger_lost", "ledger.lost", "zero", 0.0, "gate"),
+    Spec("SPLIT", "ledger_duplicated", "ledger.duplicated", "zero", 0.0,
+         "gate"),
+    Spec("SPLIT", "gates_passed", "gates.passed", "true", 0.0, "gate"),
+    Spec("SPLIT", "fused_rows", "split.rpc.fused_rows", "up", 0.50,
+         "watch"),
     # -- CONTROL (self-tuning control plane; bench.py --control) ---------
     # Headline = controller-on steady-mix throughput; the gates are the
     # A/B verdicts bench.py computes against every static arm.
