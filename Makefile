@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: analysis analysis-fixtures sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-cache-smoke rpc-smoke control-smoke fleet-obs-smoke mcts-smoke profile-smoke regress-smoke
+.PHONY: analysis analysis-fixtures sanitize-smoke sanitize test tier1 metrics-smoke soak-smoke overload-smoke coalesce-smoke async-smoke trace-smoke multichip-smoke cache-smoke cluster-smoke fleet-cache-smoke rpc-smoke control-smoke fleet-obs-smoke mcts-smoke profile-smoke regress-smoke depth-smoke
 
 # Project-invariant static checker (R1-R9); exit 0 = clean tree. The
 # JSON artifact feeds the CI annotation step (build.yml "analysis").
@@ -113,6 +113,17 @@ cluster-smoke:
 fleet-cache-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_position_tier.py -q \
 		-k "two_process or roundtrip or fallback"
+
+# Bound-aware search plane contract (doc/eval-cache.md "Bounds tier" +
+# doc/search.md "Move ordering", ≤60 s): bound-record replacement
+# (deeper wins), lower/upper cutoff correctness vs a reference
+# alpha-beta, torn bounds-slot read-as-miss in the position tier, the
+# FISHNET_NO_BOUNDS / FISHNET_NO_SPECULATION escape hatches
+# byte-for-byte, speculative pad-row fill with unchanged MCTS results,
+# the controller's speculation pin/unpin rule, and the host linger
+# window fusing staggered cross-process waves (SPLIT_r01 pathology).
+depth-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_bounds_plane.py -q
 
 # Split-plane RPC transport contract (doc/disaggregation.md, ≤45 s):
 # ring wraparound + flow control, torn-record read-as-miss, stale-epoch

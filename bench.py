@@ -807,6 +807,30 @@ SUMMARY_SCHEMA = {
     "split.phase": (
         "shape", "seconds", "jobs", "rpc", "chaos", "ledger", "drain",
     ),
+    # --depth mode (keyed by mode == "depth"): the bound-aware search
+    # plane (ISSUE 20) — one workload at a fixed node budget run
+    # hatch/hatch/cold/warm/warm_steady (warm = fresh service seeding
+    # the pool TT from the surviving bounds tier; warm_steady = one
+    # more wave against the warm-enriched tier, the long-lived
+    # production shape), a fixed-depth best-move/score parity sweep
+    # over all three psqt rungs, and the speculative pad-row escape
+    # hatch on a small MCTS round. Headline: steady warm median
+    # achieved depth minus the hatch arm's, at the same node budget
+    # (doc/eval-cache.md "Bounds tier").
+    "depth": (
+        "metric", "value", "unit", "mode", "nodes", "positions",
+        "hatch", "hatch_repeat", "cold", "warm", "warm_steady",
+        "parity", "speculation", "gates", "ledger", "bounds_cache",
+    ),
+    "depth.phase": (
+        "seconds", "nodes", "evals_shipped", "nodes_per_eval",
+        "median_depth", "depth_min", "depth_max", "bounds_seeded",
+        "bounds_harvested", "prewire_hits",
+    ),
+    "depth.rung": (
+        "rung", "jobs", "best_move_parity", "score_parity",
+        "cold_matches_hatch", "seconds",
+    ),
     # --control mode (keyed by mode == "control"): the self-tuning
     # control plane (ISSUE 18) A/B — the same two traffic mixes
     # (steady concurrent analysis vs bursty short best-move waves) run
@@ -837,7 +861,8 @@ SUMMARY_SCHEMA = {
 
 #: Every mode's summary carries the profiler section (validated below).
 for _mode_key in ("top", "overload", "multichip", "cache_replay",
-                  "mcts", "cluster", "fleet_cache", "control", "split"):
+                  "mcts", "cluster", "fleet_cache", "control", "split",
+                  "depth"):
     SUMMARY_SCHEMA[_mode_key] = SUMMARY_SCHEMA[_mode_key] + ("profile",)
 
 
@@ -942,6 +967,22 @@ def validate_summary(summary: dict) -> None:
             missing += [
                 f"{ph}.{k}"
                 for k in SUMMARY_SCHEMA["split.phase"] if k not in sub
+            ]
+        if missing:
+            raise ValueError(f"bench summary missing keys: {missing}")
+        return
+    if summary.get("mode") == "depth":
+        missing = [k for k in SUMMARY_SCHEMA["depth"] if k not in summary]
+        for ph in ("hatch", "hatch_repeat", "cold", "warm", "warm_steady"):
+            sub = summary.get(ph, {})
+            missing += [
+                f"{ph}.{k}"
+                for k in SUMMARY_SCHEMA["depth.phase"] if k not in sub
+            ]
+        for i, rung in enumerate(summary.get("parity", {}).get("rungs", [])):
+            missing += [
+                f"parity.rungs[{i}].{k}"
+                for k in SUMMARY_SCHEMA["depth.rung"] if k not in rung
             ]
         if missing:
             raise ValueError(f"bench summary missing keys: {missing}")
@@ -3045,6 +3086,381 @@ def run_cache_replay_bench(nodes: int = CACHE_REPLAY_NODES) -> dict:
     }
 
 
+#: Bound-aware search-plane bench knobs (overridable by env). The
+#: headline arms need searches deep enough for iterative re-search to
+#: matter (depth-2 searches have nothing for a TT bound to cut); 1500
+#: nodes lands the workload at median depth ~5 on the 1-core box.
+DEPTH_NODES = int(_os.environ.get("FISHNET_DEPTH_NODES", 1500))
+#: Fixed-DEPTH rung for the parity sweep: at a fixed node budget the
+#: warm arm legitimately searches deeper (that is the whole point), so
+#: best-move/score parity is only meaningful with the depth pinned.
+DEPTH_PARITY_DEPTH = int(_os.environ.get("FISHNET_DEPTH_PARITY_DEPTH", 4))
+#: Warm-arm floor on nodes per shipped eval. BENCH_r06 measured 1.673
+#: on this workload shape without the bounds tier; the seeded pool TT
+#: must clear 2.0 (cutoffs skip subtrees, TT evals skip emissions).
+DEPTH_NODES_PER_EVAL_GATE = 2.0
+DEPTH_BASELINE_NODES_PER_EVAL = 1.673
+
+
+def run_depth_bench(nodes: int = DEPTH_NODES) -> dict:
+    """Bound-aware search plane benchmark (ISSUE 20): does seeding the
+    native pool TT from the surviving bounds tier buy real depth?
+
+    Headline arms — one workload at a FIXED node budget under the gated
+    deterministic discipline:
+
+    * ``hatch``/``hatch_repeat`` — FISHNET_NO_BOUNDS=1 twice (fresh
+      caches each): the pre-PR search, and the determinism pin that
+      makes the byte-for-byte comparisons below meaningful.
+    * ``cold``  — bounds tier on, empty: every submit precedes every
+      harvest under the gate, so nothing seeds and the analyses must be
+      BYTE-IDENTICAL to the hatch arm — the FISHNET_NO_BOUNDS escape
+      hatch proven from the enabled side.
+    * ``warm``  — a NEW service (fresh pool + pool TT, the supervisor-
+      respawn shape) against the surviving BoundsCache: submits replay
+      each root's cached best-move chain into the pool TT
+      (``fc_pool_tt_fill_bound``), so re-search starts with move
+      ordering, windows and cutoffs it used to have to earn. Gate:
+      nodes/shipped-eval >= 2.0 (vs 1.673 BENCH_r06).
+    * ``warm_steady`` — one more warm wave against the cache the warm
+      wave just enriched. Under the gate every warm submit lands before
+      the first warm search finishes, so the warm wave seeds only from
+      COLD-arm harvests; the steady-state wave is the production shape
+      (re-analysis against a long-lived tier) and carries the depth
+      gate: median achieved depth STRICTLY above the hatch arm on the
+      same budget (plus the same nodes/shipped-eval >= 2.0 floor).
+
+    ``parity`` pins root best-move/score equality hatch-vs-warm at a
+    fixed depth on all three psqt rungs (the root's own record is never
+    seeded — doc/search.md "Move ordering from the bounds tier"), plus
+    cold==hatch byte-equality per rung. ``speculation`` runs a small
+    MCTS workload spec-on vs FISHNET_NO_SPECULATION=1 and requires
+    byte-identical results with nonzero speculative pad rows — the
+    second escape hatch. The exactly-once ledger audits every phase."""
+    from statistics import median
+
+    from fishnet_tpu.resilience import accounting
+    from fishnet_tpu.search import eval_cache
+    from fishnet_tpu.search.service import SearchService
+
+    weights = material_weights()
+    jobs = make_workload(4, 6, seed=44)
+    parity_jobs = make_workload(2, 3, seed=47)
+
+    class _Gated(SearchService):
+        def __init__(self, *a, **k):
+            self.gate = threading.Event()
+            super().__init__(*a, **k)
+
+        def warmup(self):
+            super().warmup()
+            self.gate.wait()
+
+    def run_wave(tag, ledger):
+        """Concurrent gated wave at the fixed node budget: every submit
+        (and so every bounds seed) lands before the first fiber runs,
+        making the schedule — and the cold arm's nothing-to-seed
+        guarantee — deterministic."""
+        svc = _Gated(
+            weights=weights, pool_slots=32, batch_capacity=256,
+            tt_bytes=16 << 20, pipeline_depth=4, driver_threads=1,
+        )
+        try:
+            svc.set_prefetch(0, adaptive=False)
+            before = svc.counters()
+            t0 = time.perf_counter()
+
+            async def go():
+                async def one(i, fen, moves):
+                    bid = f"depth-{tag}-{i}"
+                    ledger.record_acquired(bid)
+                    r = await svc.search(fen, moves, nodes=nodes)
+                    ledger.record_submitted(bid)
+                    return (
+                        r.best_move, r.depth, r.nodes,
+                        tuple(
+                            (l.multipv, l.depth, l.is_mate, l.value,
+                             tuple(l.pv))
+                            for l in r.lines
+                        ),
+                    )
+
+                tasks = [
+                    asyncio.ensure_future(one(i, *j))
+                    for i, j in enumerate(jobs)
+                ]
+                await asyncio.sleep(0.3)  # let every submission queue
+                svc.gate.set()
+                return await asyncio.gather(*tasks)
+
+            analyses = list(asyncio.run(go()))
+            elapsed = time.perf_counter() - t0
+            after = svc.counters()
+            d = {k: after[k] - before.get(k, 0) for k in after}
+            return analyses, d, elapsed
+        finally:
+            svc.gate.set()
+            svc.close()
+
+    def run_fixed_depth(tag, ledger, rung):
+        """Sequential fixed-depth arm on one forced psqt rung: each
+        job's harvest feeds the next job's seed, the production shape
+        the parity gate must hold under."""
+        svc = SearchService(
+            weights=weights, pool_slots=32, batch_capacity=256,
+            tt_bytes=16 << 20, pipeline_depth=4, driver_threads=1,
+            psqt_path=rung,
+        )
+        try:
+            svc.set_prefetch(0, adaptive=False)
+            t0 = time.perf_counter()
+
+            async def go():
+                out = []
+                for i, (fen, moves) in enumerate(parity_jobs):
+                    bid = f"depth-{tag}-{i}"
+                    ledger.record_acquired(bid)
+                    r = await svc.search(
+                        fen, moves, nodes=0, depth=DEPTH_PARITY_DEPTH
+                    )
+                    ledger.record_submitted(bid)
+                    out.append((
+                        r.best_move, r.depth, r.nodes,
+                        tuple(
+                            (l.multipv, l.depth, l.is_mate, l.value,
+                             tuple(l.pv))
+                            for l in r.lines
+                        ),
+                    ))
+                return out
+
+            return asyncio.run(go()), time.perf_counter() - t0
+        finally:
+            svc.close()
+
+    def phase(analyses, d, elapsed):
+        depths = sorted(r[1] for r in analyses)
+        shipped = max(1, d.get("evals_shipped", 0))
+        return {
+            "seconds": round(elapsed, 2),
+            "nodes": d.get("nodes", 0),
+            "evals_shipped": d.get("evals_shipped", 0),
+            "nodes_per_eval": round(d.get("nodes", 0) / shipped, 3),
+            "median_depth": float(median(depths)),
+            "depth_min": depths[0],
+            "depth_max": depths[-1],
+            "bounds_seeded": d.get("bounds_seeded", 0),
+            "bounds_harvested": d.get("bounds_harvested", 0),
+            "prewire_hits": d.get("cache_prewire_hits", 0),
+        }
+
+    def spec_round(tag, ledger, params):
+        """One small MCTS round on the shared AZ plane; returns full
+        search results + the speculative/pad row deltas."""
+        from fishnet_tpu.protocol.types import STARTPOS
+        from fishnet_tpu.search.mcts import MctsConfig, MctsPool
+
+        pool = MctsPool(
+            params, MctsConfig(batch_capacity=64, expansion_memo=1 << 14)
+        )
+        try:
+            pool.warmup()
+            b0 = (pool.counters().get("dispatch") or {})
+            sids = []
+            for i in range(4):
+                bid = f"depth-spec-{tag}-{i}"
+                ledger.record_acquired(bid)
+                sids.append((bid, pool.submit(
+                    STARTPOS, list(MCTS_OPENINGS[i % len(MCTS_OPENINGS)]),
+                    96,
+                )))
+            while pool.active() > 0:
+                pool.step()
+            results = []
+            for bid, sid in sids:
+                r = pool.harvest(sid)
+                ledger.record_submitted(bid)
+                results.append((
+                    r.best_move, r.visits, r.value,
+                    tuple(r.root_visits), tuple(r.pv),
+                ))
+            d1 = (pool.counters().get("dispatch") or {})
+            return results, {
+                k: d1.get(k, 0) - b0.get(k, 0)
+                for k in ("spec_rows", "pad_rows")
+            }
+        finally:
+            pool.close()
+
+    env_saved = {
+        k: _os.environ.get(k)
+        for k in ("FISHNET_NO_BOUNDS", "FISHNET_NO_SPECULATION")
+    }
+
+    def restore_env():
+        for k, v in env_saved.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+
+    ledger = accounting.install()
+    try:
+        # -- headline: fixed node budget, hatch/hatch/cold/warm -------
+        # Speculation pinned off for the NNUE arms (it only rides the
+        # AZ plane; pinning keeps every arm's env identical).
+        _os.environ["FISHNET_NO_SPECULATION"] = "1"
+        _os.environ["FISHNET_NO_BOUNDS"] = "1"
+        eval_cache.reset_cache()
+        h1_out, h1_d, h1_s = run_wave("hatch1", ledger)
+        log(f"bench: depth hatch  {phase(h1_out, h1_d, h1_s)}")
+        eval_cache.reset_cache()
+        h2_out, h2_d, h2_s = run_wave("hatch2", ledger)
+        log(f"bench: depth hatch' {phase(h2_out, h2_d, h2_s)}")
+
+        _os.environ["FISHNET_NO_BOUNDS"] = "0"
+        eval_cache.reset_cache()
+        c_out, c_d, c_s = run_wave("cold", ledger)
+        log(f"bench: depth cold   {phase(c_out, c_d, c_s)}")
+        w_out, w_d, w_s = run_wave("warm", ledger)
+        log(f"bench: depth warm   {phase(w_out, w_d, w_s)}")
+        w2_out, w2_d, w2_s = run_wave("warm2", ledger)
+        log(f"bench: depth warm' {phase(w2_out, w2_d, w2_s)}")
+
+        # -- parity sweep: fixed depth, per forced rung ---------------
+        rungs = []
+        for rung in ("fused", "xla", "host-material"):
+            _os.environ["FISHNET_NO_BOUNDS"] = "1"
+            eval_cache.reset_cache()
+            ph, ph_s = run_fixed_depth(f"ph-{rung}", ledger, rung)
+            _os.environ["FISHNET_NO_BOUNDS"] = "0"
+            eval_cache.reset_cache()
+            pc, pc_s = run_fixed_depth(f"pc-{rung}", ledger, rung)
+            pw, pw_s = run_fixed_depth(f"pw-{rung}", ledger, rung)
+            rungs.append({
+                "rung": rung,
+                "jobs": len(parity_jobs),
+                "best_move_parity": all(
+                    a[0] == b[0] for a, b in zip(ph, pw)
+                ),
+                "score_parity": all(
+                    a[3][0][3] == b[3][0][3] and a[3][0][2] == b[3][0][2]
+                    for a, b in zip(ph, pw)
+                ),
+                "cold_matches_hatch": pc == ph,
+                "seconds": round(ph_s + pc_s + pw_s, 2),
+            })
+            log(f"bench: depth parity {rungs[-1]}")
+
+        # -- speculation escape hatch: spec-on == spec-off ------------
+        import jax
+
+        from fishnet_tpu.models.az import init_az_params
+        from fishnet_tpu.search.mcts import MctsConfig as _McfgSpec
+
+        az_params = jax.device_put(
+            init_az_params(jax.random.PRNGKey(0), _McfgSpec().az)
+        )
+        _os.environ["FISHNET_NO_SPECULATION"] = "1"
+        eval_cache.reset_cache()
+        spec_off, _ = spec_round("off", ledger, az_params)
+        _os.environ["FISHNET_NO_SPECULATION"] = "0"
+        eval_cache.reset_cache()
+        spec_on, spec_d = spec_round("on", ledger, az_params)
+        speculation = {
+            "trees": 4,
+            "visits": 96,
+            "identical": spec_on == spec_off,
+            "speculative_rows": spec_d.get("spec_rows", 0),
+            "pad_rows": spec_d.get("pad_rows", 0),
+        }
+        log(f"bench: depth speculation {speculation}")
+
+        ledger_rep = ledger.assert_clean()
+    finally:
+        restore_env()
+        accounting.clear()
+
+    hatch_phase = phase(h1_out, h1_d, h1_s)
+    warm_phase = phase(w_out, w_d, w_s)
+    steady_phase = phase(w2_out, w2_d, w2_s)
+
+    if h1_out != h2_out:
+        raise AssertionError("hatch arm not deterministic")
+    if c_out != h1_out:
+        raise AssertionError(
+            "FISHNET_NO_BOUNDS hatch not byte-identical: cold (bounds "
+            "on, nothing to seed) diverged from the hatch arm"
+        )
+    for tag, p in (("warm", warm_phase), ("warm_steady", steady_phase)):
+        if p["nodes_per_eval"] < DEPTH_NODES_PER_EVAL_GATE:
+            raise AssertionError(
+                f"{tag} nodes/eval {p['nodes_per_eval']} < "
+                f"{DEPTH_NODES_PER_EVAL_GATE} "
+                f"(BENCH_r06 baseline {DEPTH_BASELINE_NODES_PER_EVAL})"
+            )
+    if steady_phase["median_depth"] <= hatch_phase["median_depth"]:
+        raise AssertionError(
+            f"steady warm median depth {steady_phase['median_depth']} "
+            f"not above hatch {hatch_phase['median_depth']} at {nodes} "
+            "nodes"
+        )
+    for r in rungs:
+        if not (r["best_move_parity"] and r["score_parity"]
+                and r["cold_matches_hatch"]):
+            raise AssertionError(f"parity failed on rung {r}")
+    if not speculation["identical"]:
+        raise AssertionError(
+            "FISHNET_NO_SPECULATION hatch not byte-identical"
+        )
+    if speculation["speculative_rows"] <= 0:
+        raise AssertionError("speculation arm filled no pad rows")
+
+    bcache = eval_cache.get_bounds_cache()
+    return {
+        "metric": "warm_median_depth_gain",
+        "value": round(
+            steady_phase["median_depth"] - hatch_phase["median_depth"], 2
+        ),
+        "unit": "plies",
+        "mode": "depth",
+        "profile": profile_section(),
+        "nodes": nodes,
+        "positions": len(jobs),
+        "hatch": hatch_phase,
+        "hatch_repeat": phase(h2_out, h2_d, h2_s),
+        "cold": phase(c_out, c_d, c_s),
+        "warm": warm_phase,
+        "warm_steady": steady_phase,
+        "parity": {
+            "depth": DEPTH_PARITY_DEPTH,
+            "jobs": len(parity_jobs),
+            "rungs": rungs,
+            "all": all(
+                r["best_move_parity"] and r["score_parity"]
+                and r["cold_matches_hatch"] for r in rungs
+            ),
+        },
+        "speculation": speculation,
+        "gates": {
+            "nodes_per_eval_min": DEPTH_NODES_PER_EVAL_GATE,
+            "baseline_nodes_per_eval": DEPTH_BASELINE_NODES_PER_EVAL,
+            "warm_nodes_per_eval": warm_phase["nodes_per_eval"],
+            "warm_steady_nodes_per_eval": steady_phase["nodes_per_eval"],
+            "hatch_median_depth": hatch_phase["median_depth"],
+            "warm_median_depth": warm_phase["median_depth"],
+            "warm_steady_median_depth": steady_phase["median_depth"],
+            "hatch_deterministic": True,
+            "bounds_hatch_byte_identical": True,
+            "speculation_hatch_byte_identical": True,
+            "parity_all_rungs": True,
+            "passed": True,
+        },
+        "ledger": ledger_rep,
+        "bounds_cache": bcache.stats() if bcache is not None else {},
+    }
+
+
 #: Control-plane bench knobs (overridable by env).
 CONTROL_NODES = int(_os.environ.get("FISHNET_CONTROL_NODES", 220))
 #: Fractional noise allowance on the searches/s A/B comparisons (1-core
@@ -3936,6 +4352,18 @@ def main(argv=None) -> None:
         "(see run_control_bench)",
     )
     parser.add_argument(
+        "--depth", action="store_true",
+        help="run the bound-aware search plane benchmark instead of the "
+        "throughput tiers: one workload at a fixed node budget run "
+        "hatch/cold/warm/warm_steady (warm = a fresh service seeding "
+        "the pool TT from the surviving bounds tier), gating warm "
+        "nodes/eval vs the "
+        "BENCH_r06 baseline, steady warm median depth strictly above the "
+        "FISHNET_NO_BOUNDS hatch, fixed-depth best-move/score parity "
+        "on all three psqt rungs, both escape hatches byte-for-byte, "
+        "and the exactly-once ledger (see run_depth_bench)",
+    )
+    parser.add_argument(
         "--mcts", action="store_true",
         help="run the shared-plane batched MCTS benchmark instead of "
         "the throughput tiers: AZ leaf traffic on the coalesced "
@@ -4015,6 +4443,16 @@ def main(argv=None) -> None:
             "search, off/cold/warm phases..."
         )
         summary = run_cache_replay_bench()
+        emit_summary(summary, args.json_out)
+        return
+
+    if args.depth:
+        log(
+            f"bench: depth mode — {DEPTH_NODES} nodes per search, "
+            "hatch/hatch/cold/warm + 3-rung fixed-depth parity + "
+            "speculation hatch..."
+        )
+        summary = run_depth_bench()
         emit_summary(summary, args.json_out)
         return
 

@@ -48,7 +48,10 @@ extern "C" {
 //     (Zobrist hashes of the pending batch), fc_pool_cancel_anchors
 //     (pre-provide anchor invalidation for skipped dispatches),
 //     fc_pool_tt_fill (provide-time TT fill from the host eval cache).
-int fc_abi_version() { return 10; }
+// 11: bounds-tier exports — fc_pool_tt_fill_bound (seed a full bound
+//     record: value/eval/depth/bound/move) and fc_pool_tt_export
+//     (harvest bound-carrying TT entries for the host bounds tier).
+int fc_abi_version() { return 11; }
 
 int fc_init() {
   init_bitboards();

@@ -1197,6 +1197,51 @@ void fc_pool_tt_fill(SearchPool* pool, uint64_t key, int32_t eval) {
   pool->tt.store_eval(key, int(eval));
 }
 
+// Bound-record TT fill (ABI 11): land a full search fact — value (in
+// stored/value_to_tt form), static eval, depth, bound type and best
+// move — in the pool's TT so the next search touching `key` gets a
+// cutoff or move-ordering hint, not just a cheap eval. `move_bits` is
+// the 21-bit packed move (0x1FFFFF = none); a move from a foreign
+// position is safe — search only ever COMPARES tt moves against
+// generated legal moves, never plays them blindly. Lockless
+// xor-validated TT: any-thread safe.
+void fc_pool_tt_fill_bound(SearchPool* pool, uint64_t key, int32_t value,
+                           int32_t eval, int32_t depth, int32_t bound,
+                           uint32_t move_bits) {
+  if (bound <= TT_NONE || bound > TT_EXACT) return;
+  Move m = move_bits >= 0x1FFFFF ? MOVE_NONE : Move(move_bits);
+  pool->tt.store(key, m, int(value), int(eval), int(depth), TTBound(bound));
+}
+
+// Bound-record TT export (ABI 11): probe `n` keys against the pool's
+// TT and write out the bound-carrying entries so the host can promote
+// the pool's private search facts into the process/fleet bounds tier.
+// Rows that miss (or carry no bound) get out_bounds[i] = 0 and the
+// other columns untouched. Values are exported in stored
+// (value_to_tt) form and round-trip verbatim through
+// fc_pool_tt_fill_bound. Returns the hit count. Lockless TT:
+// any-thread safe.
+int fc_pool_tt_export(SearchPool* pool, const uint64_t* keys, int n,
+                      int32_t* out_values, int32_t* out_evals,
+                      int32_t* out_depths, int32_t* out_bounds,
+                      uint32_t* out_moves) {
+  int hits = 0;
+  for (int i = 0; i < n; i++) {
+    out_bounds[i] = 0;
+    TTData tte;
+    if (!pool->tt.probe(keys[i], tte)) continue;
+    if (tte.bound == TT_NONE) continue;
+    out_values[i] = tte.value;
+    out_evals[i] = tte.eval;
+    out_depths[i] = tte.depth;
+    out_bounds[i] = int32_t(tte.bound);
+    out_moves[i] =
+        tte.move == MOVE_NONE ? 0x1FFFFF : uint32_t(tte.move) & 0x1FFFFF;
+    hits++;
+  }
+  return hits;
+}
+
 void fc_pool_release(SearchPool* pool, int slot_id) {
   if (slot_id >= 0 && slot_id < int(pool->slots.size())) {
     Slot& slot = *pool->slots[slot_id];

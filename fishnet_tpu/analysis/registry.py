@@ -50,6 +50,8 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/resilience.md"),
     Knob("FISHNET_BREAKER_THRESHOLD", "env", "5 (consecutive failures)",
          "doc/resilience.md"),
+    Knob("FISHNET_BOUNDS_CACHE_CAPACITY", "env", "65536 bound records",
+         "doc/eval-cache.md"),
     Knob("FISHNET_CACHE_PREFETCH", "env", "unset (prefetch enabled)",
          "doc/eval-cache.md"),
     Knob("FISHNET_COALESCE_WIDTH", "env", "unset (adaptive width)",
@@ -60,6 +62,8 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/eval-cache.md"),
     Knob("FISHNET_FAULT_PLAN", "env", "unset (no fault injection)",
          "doc/resilience.md", "tests/test_configure.py"),
+    Knob("FISHNET_HOST_LINGER_MS", "env", "2 (milliseconds)",
+         "doc/disaggregation.md", "tests/test_bounds_plane.py"),
     Knob("FISHNET_HOST_MATERIAL", "env", "unset (fused-PSQT wire path)",
          "doc/wire-format.md"),
     Knob("FISHNET_METRICS_PORT", "env", "unset (exporter off)",
@@ -68,6 +72,8 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/install.md"),
     Knob("FISHNET_NO_ASYNC", "env", "unset (async pipeline on)",
          "doc/observability.md", "tests/test_async_dispatch.py"),
+    Knob("FISHNET_NO_BOUNDS", "env", "unset (bounds tier on)",
+         "doc/eval-cache.md", "tests/test_bounds_plane.py"),
     Knob("FISHNET_NO_COALESCE", "env", "unset (coalescing on)",
          "doc/wire-format.md", "tests/test_coalesce.py"),
     Knob("FISHNET_NO_CONTROL", "env", "unset (control plane may actuate)",
@@ -84,6 +90,8 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/resilience.md", "tests/test_overload.py"),
     Knob("FISHNET_NO_SHARED_AZ_PLANE", "env", "unset (shared plane on)",
          "doc/search.md", "tests/test_mcts_plane.py"),
+    Knob("FISHNET_NO_SPECULATION", "env", "unset (speculative pads on)",
+         "doc/search.md", "tests/test_bounds_plane.py"),
     Knob("FISHNET_NO_SUBTREE_REUSE", "env", "unset (subtree reuse on)",
          "doc/search.md"),
     Knob("FISHNET_POSITION_TIER", "env", "unset (fleet tier off)",
@@ -95,6 +103,8 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/eval-cache.md", "tests/test_position_tier.py"),
     Knob("FISHNET_POSITION_TIER_AZ_CAPACITY", "env", "256 AZ slots",
          "doc/eval-cache.md", "tests/test_position_tier.py"),
+    Knob("FISHNET_POSITION_TIER_BOUNDS_CAPACITY", "env", "16384 bound slots",
+         "doc/eval-cache.md", "tests/test_bounds_plane.py"),
     Knob("FISHNET_PROFILE", "env", "unset (profiler off)",
          "doc/observability.md", "tests/test_profiler.py"),
     Knob("FISHNET_PROFILE_HZ", "env", "29 (samples/second)",
@@ -116,6 +126,8 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/observability.md", "tests/test_tracing.py"),
     Knob("FISHNET_SPANS_FILE", "env", "unset (per-pid file in spans dir)",
          "doc/observability.md", "tests/test_tracing.py"),
+    Knob("FISHNET_SPECULATION_BUDGET", "env", "8 pad rows per dispatch",
+         "doc/search.md", "tests/test_bounds_plane.py"),
     Knob("FISHNET_TPU_CORE_LIB", "env", "bundled libfishnet_core",
          "doc/install.md"),
     Knob("FISHNET_TPU_UPDATE_ATTEMPTED", "env", "unset (recursion guard)",
@@ -135,6 +147,8 @@ KNOBS: Tuple[Knob, ...] = (
          "doc/control-plane.md", "tests/test_control.py"),
     Knob("--cores", "cli", "auto (n-1)", "README.md",
          "tests/test_configure.py"),
+    Knob("--depth", "cli", "off (bench.py mode flag)",
+         "doc/eval-cache.md", "tests/test_bounds_plane.py"),
     Knob("--drain-deadline", "cli", "10s", "doc/resilience.md",
          "tests/test_cluster.py"),
     Knob("--endpoint", "cli", "https://lichess.org/fishnet",

@@ -28,7 +28,7 @@ _lib_lock = threading.Lock()
 #: built from different-era sources must be rejected, not loaded: ctypes
 #: has no signature checking, so a mismatched argument layout corrupts
 #: memory silently.
-ABI_VERSION = 10
+ABI_VERSION = 11
 
 
 class NativeCoreError(RuntimeError):

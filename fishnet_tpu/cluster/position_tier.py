@@ -9,7 +9,7 @@ and probes pre-wire, right after its process-local cache misses. The
 fallback ladder per position is strictly local -> fleet -> miss
 (doc/eval-cache.md "Fleet tier").
 
-Two keyspaces ride the same segment, mirroring the two process caches:
+Three keyspaces ride the same segment, mirroring the process caches:
 
 * **NNUE region** — 32-byte slots keyed ``zobrist ^ net_fingerprint``
   holding the EXACT int32 static eval. Values are stored bit-exact (not
@@ -20,6 +20,12 @@ Two keyspaces ride the same segment, mirroring the two process caches:
   policy row plus the float32 value — the same fp16 eval round-trip the
   ``AzEvalCache`` stores, so fleet hits reconstruct identical fp32
   bits.
+* **Bounds region** (v2) — 48-byte slots keyed like the NNUE region
+  holding full TT bound records ``(value, eval, depth, bound,
+  best-move)`` in the native representation, so one frontend's search
+  facts seed every sibling's pool TT (doc/eval-cache.md "Bounds
+  tier"). Same-key replacement is deeper-entry-wins, matching the
+  process ``BoundsCache``.
 
 Cross-process safety WITHOUT cross-process locks: plain files have no
 shared mutexes, so every slot carries a generation-stamped seqlock
@@ -65,15 +71,22 @@ TIER_PATH_ENV = "FISHNET_POSITION_TIER_PATH"
 TIER_CAPACITY_ENV = "FISHNET_POSITION_TIER_CAPACITY"
 #: AZ-region slot count (~9.4 KB each — fp16 policy payload).
 TIER_AZ_CAPACITY_ENV = "FISHNET_POSITION_TIER_AZ_CAPACITY"
+#: Bounds-region slot count (48 bytes each — full TT bound records).
+TIER_BOUNDS_CAPACITY_ENV = "FISHNET_POSITION_TIER_BOUNDS_CAPACITY"
 
 _MAGIC = 0x46_4E_50_54_49_45_52_31  # "FNPTIER1"
-_VERSION = 1
+# v2: bounds region appended after the AZ region; header gains
+# ``bounds_slots`` (doc/eval-cache.md "Bounds tier"). A v1 segment
+# fails the version check and the process falls back tier-off — the
+# same graceful-attach contract as any geometry mismatch.
+_VERSION = 2
 _HEADER_BYTES = 4096
 _U64 = (1 << 64) - 1
 _MIX = 0x9E3779B97F4A7C15  # splitmix64 odd constant (index mixing)
 
 DEFAULT_NNUE_SLOTS = 1 << 16
 DEFAULT_AZ_SLOTS = 256
+DEFAULT_BOUNDS_SLOTS = 1 << 14
 #: AZ policy width (models/az.py POLICY_SIZE); carried in the header so
 #: an attach against a different architecture fails cleanly instead of
 #: reading misaligned rows.
@@ -88,6 +101,7 @@ _HEADER_DTYPE = np.dtype([
     ("nnue_slots", "<u4"),
     ("az_slots", "<u4"),
     ("policy_size", "<u4"),
+    ("bounds_slots", "<u4"),
     ("generation", "<u8"),
 ])
 
@@ -100,6 +114,25 @@ _NNUE_SLOT_DTYPE = np.dtype([
     ("check", "<u8"),
 ])
 assert _NNUE_SLOT_DTYPE.itemsize == 32
+
+#: Bounds region: one full TT bound record per slot — value in the
+#: native stored (value_to_tt) form, static eval, depth, bound type
+#: (1=upper/2=lower/3=exact) and the 21-bit packed best move — the same
+#: columns ``fc_pool_tt_fill_bound`` consumes, so a fleet hit seeds a
+#: sibling's pool TT without any host-side decode.
+_BOUNDS_SLOT_DTYPE = np.dtype([
+    ("key", "<u8"),
+    ("value", "<i4"),
+    ("eval", "<i4"),
+    ("depth", "<u4"),
+    ("bound", "<u4"),
+    ("move", "<u4"),
+    ("owner", "<u4"),
+    ("seq", "<u4"),
+    ("gen", "<u4"),
+    ("check", "<u8"),
+])
+assert _BOUNDS_SLOT_DTYPE.itemsize == 48
 
 
 def _az_slot_dtype(policy_size: int) -> np.dtype:
@@ -145,6 +178,13 @@ def _az_check(key: int, value_bits: int, owner: int,
     return (key ^ value_bits ^ (owner * _MIX) ^ acc) & _U64
 
 
+def _bounds_check(key: int, value: int, eval_: int, depth: int,
+                  bound: int, move: int, owner: int) -> int:
+    lo = ((value & 0xFFFFFFFF) | ((eval_ & 0xFFFFFFFF) << 32)) & _U64
+    hi = (depth | (bound << 8) | (move << 16)) & _U64
+    return (key ^ lo ^ ((hi * _MIX) & _U64) ^ ((owner * _MIX) & _U64)) & _U64
+
+
 class PositionTier:
     """One attached shared-memory position segment (both keyspaces).
 
@@ -154,7 +194,8 @@ class PositionTier:
     same keys they use against the process caches."""
 
     def __init__(self, path: str, mm: mmap.mmap, nnue_slots: int,
-                 az_slots: int, policy_size: int) -> None:
+                 az_slots: int, policy_size: int,
+                 bounds_slots: int = DEFAULT_BOUNDS_SLOTS) -> None:
         self.path = path
         self._mm = mm
         self._owner = os.getpid() & 0xFFFFFFFF
@@ -169,8 +210,17 @@ class PositionTier:
             mm, dtype=az_dtype, count=az_slots,
             offset=_HEADER_BYTES + nnue_slots * _NNUE_SLOT_DTYPE.itemsize,
         )
+        self._bounds = np.frombuffer(
+            mm, dtype=_BOUNDS_SLOT_DTYPE, count=bounds_slots,
+            offset=(
+                _HEADER_BYTES
+                + nnue_slots * _NNUE_SLOT_DTYPE.itemsize
+                + az_slots * az_dtype.itemsize
+            ),
+        )
         self._nnue_slots = nnue_slots
         self._az_slots = az_slots
+        self._bounds_slots = bounds_slots
         self._locks = [threading.Lock() for _ in range(_N_STRIPES)]
 
     # -- slot addressing ---------------------------------------------------
@@ -382,6 +432,147 @@ class PositionTier:
         if evicted:
             _count_evict("az", 1)
 
+    # -- bounds keyspace ---------------------------------------------------
+
+    def _read_bound(
+        self, idx: int, key: int
+    ) -> Optional[Tuple[int, int, int, int, int, int]]:
+        """Validated ``(value, eval, depth, bound, move, owner)`` for
+        ``key`` at slot ``idx``, or None (empty / other key / torn)."""
+        slot = self._bounds[idx]
+        s1 = int(slot["seq"])
+        if s1 & 1:
+            return None  # write in progress (or a dead writer's slot)
+        if int(slot["key"]) != key:
+            return None
+        value = int(np.int32(slot["value"]))
+        eval_ = int(np.int32(slot["eval"]))
+        depth = int(slot["depth"])
+        bound = int(slot["bound"])
+        move = int(slot["move"])
+        owner = int(slot["owner"])
+        check = int(slot["check"])
+        if int(slot["seq"]) != s1:
+            return None  # torn: a writer landed mid-read
+        if bound == 0 or check != _bounds_check(
+            key, value, eval_, depth, bound, move, owner
+        ):
+            return None  # torn or interleaved write
+        return value, eval_, depth, bound, move, owner
+
+    def probe_bounds_block(
+        self,
+        keys: np.ndarray,
+        values: np.ndarray,
+        evals: np.ndarray,
+        depths: np.ndarray,
+        bounds: np.ndarray,
+        moves: np.ndarray,
+    ) -> int:
+        """Fill the MISS rows (``bounds[i] == 0``) of a process
+        bounds-cache probe from the fleet segment; the column layout
+        matches ``BoundsCache.probe_bounds_block``. Returns rows
+        filled."""
+        hits_local = hits_fleet = misses = 0
+        for i in range(len(keys)):
+            if bounds[i]:
+                continue
+            key = int(keys[i])
+            found = None
+            for idx in self._window(key, self._bounds_slots):
+                found = self._read_bound(idx % self._bounds_slots, key)
+                if found is not None:
+                    break
+            if found is None:
+                misses += 1
+                continue
+            values[i], evals[i], depths[i], bounds[i], moves[i], owner = found
+            if owner == self._owner:
+                hits_local += 1
+            else:
+                hits_fleet += 1
+        _count("bounds", hits_local, hits_fleet, misses)
+        return hits_local + hits_fleet
+
+    def insert_bound(self, key: int, value: int, eval_: int, depth: int,
+                     bound: int, move: int) -> None:
+        """Publish one bound record. Same-key replacement is
+        deeper-entry-wins (the :class:`BoundsCache` policy): a live
+        same-key slot holding a strictly deeper record is left alone —
+        a shallow re-search must never clobber the deep record a
+        sibling paid for. Cross-key collisions evict lowest-gen, like
+        the other regions."""
+        if bound <= 0 or bound > 3:
+            return
+        key = int(key) & _U64
+        gen = int(self._header["generation"][0]) & 0xFFFFFFFF
+        window = self._window(key, self._bounds_slots)
+        target = None
+        victim = None
+        victim_gen = None
+        for idx in window:
+            idx %= self._bounds_slots
+            slot = self._bounds[idx]
+            k = int(slot["key"])
+            if k == key:
+                if int(slot["depth"]) > depth and not (int(slot["seq"]) & 1):
+                    return  # resident record is deeper; keep it
+                target = idx
+                break
+            if k == 0 and int(slot["seq"]) == 0:
+                if target is None:
+                    target = idx
+                continue
+            g = int(slot["gen"])
+            if victim_gen is None or g < victim_gen:
+                victim, victim_gen = idx, g
+        evicted = 0
+        if target is None:
+            target = victim if victim is not None else (
+                self._mix(key) % self._bounds_slots
+            )
+            evicted = 1
+        check = _bounds_check(
+            key, value, eval_, depth, bound, move, self._owner
+        )
+        with self._locks[target & (_N_STRIPES - 1)]:
+            slot = self._bounds[target]
+            s = int(slot["seq"])
+            slot["seq"] = ((s + 1) | 1) & 0xFFFFFFFF  # odd: mid-write
+            slot["key"] = key
+            slot["value"] = np.int32(value)
+            slot["eval"] = np.int32(eval_)
+            slot["depth"] = depth & 0xFFFFFFFF
+            slot["bound"] = bound
+            slot["move"] = move & 0xFFFFFFFF
+            slot["owner"] = self._owner
+            slot["gen"] = gen
+            slot["check"] = check
+            slot["seq"] = (((s + 1) | 1) + 1) & 0xFFFFFFFF  # even: published
+        if evicted:
+            _count_evict("bounds", 1)
+
+    def insert_bounds_block(
+        self,
+        keys: np.ndarray,
+        values: np.ndarray,
+        evals: np.ndarray,
+        depths: np.ndarray,
+        bounds: np.ndarray,
+        moves: np.ndarray,
+    ) -> None:
+        """Publish a harvested batch of bound records (rows with
+        ``bounds[i] == 0`` are skipped — the harvest layout marks
+        misses that way)."""
+        for i in range(len(keys)):
+            if not bounds[i]:
+                continue
+            self.insert_bound(
+                int(keys[i]), int(np.int32(values[i])),
+                int(np.int32(evals[i])), int(depths[i]), int(bounds[i]),
+                int(moves[i]),
+            )
+
     # -- shared clock ------------------------------------------------------
 
     def advance_generation(self) -> int:
@@ -397,7 +588,7 @@ class PositionTier:
 
     def close(self) -> None:
         # Release the numpy views before the mmap (else BufferError).
-        self._header = self._nnue = self._az = None
+        self._header = self._nnue = self._az = self._bounds = None
         try:
             self._mm.close()
         except (BufferError, ValueError):
@@ -448,7 +639,7 @@ def _collect_postier() -> Optional[List]:
     with _count_lock:
         snap = dict(_counts)
     fams = []
-    for fam in ("nnue", "az"):
+    for fam in ("nnue", "az", "bounds"):
         for scope in ("local", "fleet"):
             fams.append(counter_family(
                 "fishnet_postier_hits_total",
@@ -493,11 +684,13 @@ _collector_token: Optional[int] = None
 def _attach(path: str) -> PositionTier:
     nnue_slots = _env_slots(TIER_CAPACITY_ENV, DEFAULT_NNUE_SLOTS)
     az_slots = _env_slots(TIER_AZ_CAPACITY_ENV, DEFAULT_AZ_SLOTS)
+    bounds_slots = _env_slots(TIER_BOUNDS_CAPACITY_ENV, DEFAULT_BOUNDS_SLOTS)
     az_itemsize = _az_slot_dtype(AZ_POLICY_SIZE).itemsize
     size = (
         _HEADER_BYTES
         + nnue_slots * _NNUE_SLOT_DTYPE.itemsize
         + az_slots * az_itemsize
+        + bounds_slots * _BOUNDS_SLOT_DTYPE.itemsize
     )
     fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
     try:
@@ -515,6 +708,7 @@ def _attach(path: str) -> PositionTier:
             header["nnue_slots"] = nnue_slots
             header["az_slots"] = az_slots
             header["policy_size"] = AZ_POLICY_SIZE
+            header["bounds_slots"] = bounds_slots
             header["generation"] = 1
             header["magic"] = _MAGIC
         else:
@@ -527,18 +721,24 @@ def _attach(path: str) -> PositionTier:
             nnue_slots = int(header["nnue_slots"][0])
             az_slots = int(header["az_slots"][0])
             policy = int(header["policy_size"][0])
+            bounds_slots = int(header["bounds_slots"][0])
             expect = (
                 _HEADER_BYTES
                 + nnue_slots * _NNUE_SLOT_DTYPE.itemsize
                 + az_slots * _az_slot_dtype(policy).itemsize
+                + bounds_slots * _BOUNDS_SLOT_DTYPE.itemsize
             )
-            if policy != AZ_POLICY_SIZE or existing < expect:
+            if (
+                policy != AZ_POLICY_SIZE
+                or bounds_slots < _PROBE_WINDOW
+                or existing < expect
+            ):
                 raise ValueError(f"{path}: tier geometry mismatch")
         del header  # release the view; PositionTier re-views
     finally:
         os.close(fd)
     return PositionTier(
-        path, mm, nnue_slots, az_slots, AZ_POLICY_SIZE
+        path, mm, nnue_slots, az_slots, AZ_POLICY_SIZE, bounds_slots
     )
 
 

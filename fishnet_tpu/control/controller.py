@@ -31,6 +31,13 @@ WATERMARK_FLOOR = 64
 #: the service's own steering hysteresis at search/service.py).
 PREFETCH_PIN = 0.6
 PREFETCH_UNPIN = 0.3
+#: Dispatch-fill thresholds for pinning / unpinning speculative
+#: pad-row evals (az_plane.set_speculation_budget): above PIN the pow2
+#: buckets are nearly full — speculation has no free slots to ride and
+#: would only displace padding that does not exist; below UNPIN the
+#: padding is back and the static budget is restored.
+SPECULATION_PIN = 0.9
+SPECULATION_UNPIN = 0.5
 #: A tenant must burn more than this share of window device-ms before
 #: an SLO burn reweights its admission.
 COST_HOG_SHARE = 0.5
@@ -150,6 +157,9 @@ class RuleProbePolicy:
       device-ms, downweight its DRR admission;
     * pre-dispatch cache hot (hit rate > 0.6) -> pin prefetch off;
       cold again (< 0.3) -> restore adaptive prefetch;
+    * AZ dispatch fill high (> 0.9) -> pin the speculative pad-row
+      budget to 0 (the pow2 buckets carry no padding worth filling);
+      fill back under 0.5 -> restore the bind-time budget;
     * ``calm_hold`` consecutive QUIESCENT windows (no eval traffic, no
       rule fired, no SLO burning) -> step ONE moved knob back toward
       its static default per window, sorted order, so a transient
@@ -242,6 +252,23 @@ class RuleProbePolicy:
                     "adaptive prefetch",
                 ))
 
+        if "speculation_budget" in knobs:
+            fill = sig.counters.get("dispatch_fill")
+            pinned = knobs.get("speculation_budget") is not None
+            if fill is not None:
+                if fill > SPECULATION_PIN and not pinned:
+                    actions.append(Action(
+                        "speculation_budget", 0,
+                        f"dispatch fill {fill:.0%}: padding scarce, "
+                        "pin speculation off",
+                    ))
+                elif fill < SPECULATION_UNPIN and pinned:
+                    actions.append(Action(
+                        "speculation_budget", None,
+                        f"dispatch fill {fill:.0%}: padding back, "
+                        "restore speculation budget",
+                    ))
+
         if actions or slo_hot or live:
             # Live traffic keeps the current tuning earning its keep:
             # step-back waits for quiescence, not just for quiet rules.
@@ -253,9 +280,9 @@ class RuleProbePolicy:
             for knob in sorted(knobs):
                 if knobs.get(knob) is None:
                     continue
-                if knob == "prefetch_budget":
-                    # Pinning is governed by the hit-rate rule above,
-                    # not the calm step-back.
+                if knob in ("prefetch_budget", "speculation_budget"):
+                    # Pinning is governed by the hit-rate / dispatch-fill
+                    # rules above, not the calm step-back.
                     continue
                 self._calm = 0
                 return [Action(
@@ -353,6 +380,7 @@ class Controller:
 
 def standard_actuators(
     service=None, shed_policy=None, mcts_pool=None, scheduler=None,
+    az_plane=None,
 ):
     """The stock actuator set for whatever subsystems are wired.
     Defaults are captured HERE, at bind time — that snapshot is what
@@ -413,13 +441,29 @@ def standard_actuators(
             lo=0.25, hi=4.0, default={},
             getter=scheduler.tenant_weights,
         ))
+    if az_plane is not None:
+        spec_default = az_plane.speculation_budget()
+
+        def set_speculation(value) -> None:
+            # None restores the bind-time budget; like prefetch_budget
+            # the knob has no getter, so snapshot()/knobs reflect the
+            # pinned state (non-None only while a rule holds it).
+            az_plane.set_speculation_budget(
+                spec_default if value is None else int(value)
+            )
+
+        acts.append(Actuator(
+            name="speculation_budget",
+            setter=set_speculation,
+            lo=0, hi=64, default=None,
+        ))
     return acts
 
 
 def build_controller(
     service=None, shed_policy=None, mcts_pool=None, scheduler=None,
     slo_engine=None, policy: Optional[Policy] = None,
-    margin: float = 0.10, hold: int = 2,
+    margin: float = 0.10, hold: int = 2, az_plane=None,
 ) -> Controller:
     """Wire the stock control plane over the given subsystems: a
     collector attached to the stage-observer hook, a registry holding
@@ -427,12 +471,12 @@ def build_controller(
     chosen policy. Call ``shutdown_controller()`` when done."""
     collector = SignalCollector(
         service=service, slo_engine=slo_engine, scheduler=scheduler,
-        margin=margin, hold=hold,
+        margin=margin, hold=hold, az_plane=az_plane,
     ).attach()
     registry = ActuatorRegistry()
     registry.register_all(standard_actuators(
         service=service, shed_policy=shed_policy,
-        mcts_pool=mcts_pool, scheduler=scheduler,
+        mcts_pool=mcts_pool, scheduler=scheduler, az_plane=az_plane,
     ))
     return Controller(collector, registry, policy=policy)
 

@@ -185,6 +185,7 @@ class SignalCollector:
         slo_engine=None,
         scheduler=None,
         counters_fn: Optional[Callable[[], Dict[str, int]]] = None,
+        az_plane=None,
         margin: float = 0.10,
         hold: int = 2,
     ) -> None:
@@ -192,6 +193,8 @@ class SignalCollector:
         self._slo = slo_engine
         self._scheduler = scheduler
         self._counters_fn = counters_fn
+        self._az_plane = az_plane
+        self._last_az: Dict[str, float] = {}
         self._accum = _StageAccum()
         self._switch = HysteresisSwitch(margin=margin, hold=hold)
         self._window = 0
@@ -304,7 +307,8 @@ class SignalCollector:
             # Level gauges ride as-is, not as deltas.
             for k in ("decode_queue", "inflight_dispatches",
                       "async_ready_queue", "latency_active",
-                      "prefetch_budget"):
+                      "prefetch_budget", "dispatch_fill",
+                      "speculation_budget"):
                 if k in cur:
                     delta[k] = float(cur[k])
             self._last_counters = cur
@@ -314,6 +318,30 @@ class SignalCollector:
                 1.0,
                 (delta.get("cache_prewire_hits", 0.0)
                  + delta.get("tt_eval_hits", 0.0)) / shipped,
+            )
+
+        # AZ dispatch plane: WINDOW fill ratio (real rows over shipped
+        # device slots this window — the speculation rule's pin signal)
+        # plus pad/speculation deltas. ``dispatch_fill`` is set only
+        # when the window shipped slots: a quiet window must not read
+        # as "0% fill" and flap the speculation pin.
+        plane = self._az_plane
+        if plane is not None:
+            az = plane.counters()
+            rows = float(az.get("rows_dispatched", 0))
+            slots = float(az.get("slots_dispatched", 0))
+            drows = rows - self._last_az.get("rows", 0.0)
+            dslots = slots - self._last_az.get("slots", 0.0)
+            self._last_az["rows"] = rows
+            self._last_az["slots"] = slots
+            if dslots > 0.0:
+                sig.counters["dispatch_fill"] = min(1.0, drows / dslots)
+            for k in ("pad_rows", "spec_rows"):
+                v = float(az.get(k, 0))
+                sig.counters["az_" + k] = v - self._last_az.get(k, 0.0)
+                self._last_az[k] = v
+            sig.counters["speculation_budget"] = float(
+                az.get("speculation_budget", 0)
             )
 
         # SLO burn (programmatic seam — no self-scrape over HTTP).

@@ -42,8 +42,10 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from fishnet_tpu import telemetry as _telemetry
 from fishnet_tpu.resilience import faults
 from fishnet_tpu.rpc import rings
+from fishnet_tpu.telemetry.spans import RECORDER as _SPANS
 from fishnet_tpu.search.service import (
     CoalesceBackend,
     NativeCoreError,
@@ -86,6 +88,8 @@ class _HostNnueBackend(CoalesceBackend):
         from fishnet_tpu.nnue import spec
         from fishnet_tpu.nnue.jax_eval import evaluate_batch_jit
 
+        tel = _telemetry.enabled()
+        t0 = time.monotonic() if tel else 0.0
         total = sum(len(s[1]) for s in segs)
         bucket = _pad_bucket(total)
         feats = np.full((bucket, 2, 32), spec.NUM_FEATURES, np.uint16)
@@ -115,6 +119,13 @@ class _HostNnueBackend(CoalesceBackend):
         )
         rings.note("fused.rows.nnue", total)
         rings.note("fused.slots.nnue", bucket)
+        if bucket > total:
+            rings.note("pad.rows", bucket - total)
+        if tel:
+            _SPANS.record(
+                "dispatch_issue", t0, width=len(segs),
+                n=total, slots=bucket, fill=total / bucket,
+            )
         return values
 
     def _dispatch_eval(self, group: int, n: int, rows: int):
@@ -167,6 +178,8 @@ class _HostAzBackend(CoalesceBackend):
         self._staged[group] = planes_u8
 
     def _run(self, segs: List[np.ndarray]):
+        tel = _telemetry.enabled()
+        t0 = time.monotonic() if tel else 0.0
         total = sum(len(s) for s in segs)
         bucket = _pad_bucket(total)
         planes = np.zeros((bucket,) + rings.AZ_PLANE_SHAPE, np.uint8)
@@ -177,6 +190,13 @@ class _HostAzBackend(CoalesceBackend):
         logits16, values = self._fwd(self._params, planes)
         rings.note("fused.rows.az", total)
         rings.note("fused.slots.az", bucket)
+        if bucket > total:
+            rings.note("pad.rows", bucket - total)
+        if tel:
+            _SPANS.record(
+                "dispatch_issue", t0, width=len(segs),
+                n=total, slots=bucket, fill=total / bucket,
+            )
         return (
             np.asarray(logits16, np.float16),
             np.asarray(values, np.float32),
@@ -218,10 +238,16 @@ class EvaluatorHost:
         rpc_dir: Optional[str] = None,
         lease_s: float = rings.LEASE_S,
         poll_s: float = 0.002,
+        linger_s: Optional[float] = None,
     ) -> None:
         self._dir = rpc_dir or rings.rpc_dir()
         self._lease_s = lease_s
         self._poll_s = poll_s
+        if linger_s is None:
+            linger_s = float(
+                os.environ.get("FISHNET_HOST_LINGER_MS", "2")
+            ) / 1000.0
+        self._linger_s = max(0.0, linger_s)
         self._links: Dict[str, rings.RingLink] = {}
         self._groups = itertools.count(1)
         self._nnue = (
@@ -281,6 +307,24 @@ class EvaluatorHost:
 
     # -- the sweep ---------------------------------------------------------
 
+    def _drain(self) -> List[Tuple]:
+        """Beat, reap, and drain every attached link's submit ring;
+        returns the fenced-filtered records. Called once per sweep plus
+        once per linger re-drain tick."""
+        work: List[Tuple] = []
+        for path, link in list(self._links.items()):
+            link.beat()
+            if link.peer_age() > self._lease_s:
+                self._detach(path, "lease", unlink=True)
+                continue
+            for kind, ticket, epoch, n, payload in link.drain():
+                if epoch < link.frontend_epoch:
+                    # Fenced: a record from the link's previous life.
+                    rings.note("stale_refusals")
+                    continue
+                work.append((link, kind, ticket, epoch, n, payload))
+        return work
+
     def sweep(self) -> int:
         """One full service round: scan, fault poll, lease reap, drain,
         fuse-dispatch, fan results back. Returns records served."""
@@ -295,20 +339,26 @@ class EvaluatorHost:
                 self._detach(
                     sorted(self._links)[0], "fault", unlink=False
                 )
-        work: List[Tuple] = []
-        for path, link in list(self._links.items()):
-            link.beat()
-            if link.peer_age() > self._lease_s:
-                self._detach(path, "lease", unlink=True)
-                continue
-            for kind, ticket, epoch, n, payload in link.drain():
-                if epoch < link.frontend_epoch:
-                    # Fenced: a record from the link's previous life.
-                    rings.note("stale_refusals")
-                    continue
-                work.append((link, kind, ticket, epoch, n, payload))
+        work = self._drain()
         if not work:
             return 0
+        if self._linger_s > 0.0 and len(self._links) > 1:
+            # Cross-process fusion pathology (SPLIT_r01): K frontends'
+            # waves land microseconds apart, so each sweep used to
+            # catch ONE wave and pay its own pow2 bucket — 3×40-row
+            # waves dispatched as three 64-slot buckets (192 slots)
+            # instead of one 128-slot fused dispatch. A bounded linger
+            # re-drains the rings until the window closes, so skewed
+            # waves bucket by their FUSED row count. Gated on multiple
+            # attached links: with one frontend the linger is pure
+            # latency with nothing to fuse.
+            deadline = time.monotonic() + self._linger_s
+            while True:
+                now = time.monotonic()
+                if now >= deadline:
+                    break
+                time.sleep(min(0.0005, deadline - now))
+                work.extend(self._drain())
         staged = []
         for link, kind, ticket, epoch, n, payload in work:
             gid = next(self._groups)
@@ -407,6 +457,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="AZ bucket-ladder capacity")
     parser.add_argument("--lease", type=float, default=rings.LEASE_S)
     parser.add_argument("--poll", type=float, default=0.002)
+    parser.add_argument("--linger-ms", type=float, default=None,
+                        help="cross-frontend fusion window (default: "
+                        "FISHNET_HOST_LINGER_MS, 2ms)")
     parser.add_argument("--metrics-port", type=int, default=None)
     parser.add_argument("--metrics-port-file", default=None)
     args = parser.parse_args(argv)
@@ -449,6 +502,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     host = EvaluatorHost(
         nnue_params=nnue_params, az_params=az_params, az_cfg=az_cfg,
         rpc_dir=args.dir, lease_s=args.lease, poll_s=args.poll,
+        linger_s=(
+            None if args.linger_ms is None else args.linger_ms / 1000.0
+        ),
     )
     try:
         host.serve_forever()

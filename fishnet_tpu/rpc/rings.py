@@ -623,6 +623,13 @@ def _collect_rpc() -> Optional[List]:
             labels={"family": family},
         ))
     fams.append(counter_family(
+        "fishnet_dispatch_pad_rows_total",
+        "Padding slots shipped in device dispatches (bucket size minus "
+        "real entries), by dispatch path.",
+        snap.get("pad.rows", 0),
+        labels={"path": "host"},
+    ))
+    fams.append(counter_family(
         "fishnet_rpc_torn_total",
         "Ring records skipped by the seqlock/checksum validation (a "
         "SIGKILLed peer's torn write reads as a miss, never a value).",

@@ -53,6 +53,7 @@ from __future__ import annotations
 import os
 import threading
 import weakref
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -91,26 +92,64 @@ def plane_disabled() -> bool:
     return os.environ.get("FISHNET_NO_SHARED_AZ_PLANE", "") == "1"
 
 
+def speculation_disabled() -> bool:
+    """Speculative pad-row escape hatch (``FISHNET_NO_SPECULATION=1``),
+    read per call like :func:`plane_disabled`. Also implied by the eval
+    cache hatch: speculative results land ONLY in the cache/fleet tier,
+    so with no cache they would be pure wasted compute. With it set, no
+    pad row is ever repurposed — dispatches are byte-for-byte today's
+    (pad rows hold stale staging content, consumers never read them)."""
+    return (
+        _eval_cache.cache_disabled()
+        or os.environ.get("FISHNET_NO_SPECULATION", "") == "1"
+    )
+
+
+#: Default speculative rows per dispatch when FISHNET_SPECULATION_BUDGET
+#: is unset. Small by design: speculation only ever rides slots the pow2
+#: ladder already paid for, and the control plane re-tunes it live.
+DEFAULT_SPECULATION_BUDGET = 8
+
+
 class _AzValues(_FusedValues):
     """A fused AZ dispatch's payload: a tuple of ``(logits_dev,
-    values_dev, n_used)`` chunks, materialized ONCE into a list of
-    per-row ``(logits_f32 [4672], value)`` pairs. A list, not an
+    values_dev, n_used, spec_keys)`` chunks, materialized ONCE into a
+    list of per-row ``(logits_f32 [4672], value)`` pairs. A list, not an
     ndarray, so the coalescer's segment slicing (``[start : start +
     seg_size]``) and the decode worker's eager ``materialize()`` both
-    work unchanged on the shared machinery."""
+    work unchanged on the shared machinery.
 
-    __slots__ = ()
+    ``spec_keys`` are the salted cache keys of speculative pad rows the
+    plane parked at ``[n_used : n_used + len(spec_keys)]`` of the chunk
+    (doc/eval-cache.md "Speculative pad rows"); ``sink`` receives their
+    fp16 logits + values exactly once, at materialize time — the first
+    device->host transfer that exists anyway — so speculation adds no
+    extra sync point. Demand consumers still read ``[:n_used]`` only,
+    untouched by whatever rides the padding."""
+
+    __slots__ = ("_sink",)
+
+    def __init__(self, arr, sink=None) -> None:
+        super().__init__(arr)
+        self._sink = sink
 
     def materialize(self) -> list:  # type: ignore[override]
         with self._lock:
             if self._np is None:
                 rows: list = []
-                for logits_dev, values_dev, k in self._arr:
-                    lg = np.asarray(logits_dev)[:k].astype(np.float32)
-                    vals = np.asarray(values_dev)[:k]
+                for logits_dev, values_dev, k, spec in self._arr:
+                    lg16 = np.asarray(logits_dev)
+                    vals = np.asarray(values_dev)
+                    lg = lg16[:k].astype(np.float32)
                     rows.extend(
                         (lg[i], float(vals[i])) for i in range(k)
                     )
+                    if spec and self._sink is not None:
+                        self._sink(
+                            spec,
+                            lg16[k : k + len(spec)],
+                            vals[k : k + len(spec)],
+                        )
                 self._np = rows
                 self._arr = None
             return self._np
@@ -209,6 +248,20 @@ class AzDispatchPlane(CoalesceBackend):
         self._skipped_dispatches = 0
         self._rows_dispatched = 0
         self._slots_dispatched = 0
+        # Speculative pad rows (doc/eval-cache.md "Speculative pad
+        # rows"): a bounded queue of candidate positions (salted key ->
+        # wire planes) that _dispatch_chunks parks in slots the pow2
+        # bucket ladder would otherwise ship as padding. The budget is
+        # a control-plane actuator (set_speculation_budget); 0 pins
+        # speculation off without touching the env hatch.
+        self._spec_lock = threading.Lock()
+        self._spec_queue: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        budget = _env_int("FISHNET_SPECULATION_BUDGET")
+        self._spec_budget = (
+            DEFAULT_SPECULATION_BUDGET if budget is None else max(0, budget)
+        )
+        self._pad_rows = 0
+        self._spec_rows = 0
         self._closed = False
         # Cost-plane tenant tag for this plane's dispatches (telemetry/
         # cost.py): AZ leaf traffic is selfplay by default; a serving
@@ -256,6 +309,79 @@ class AzDispatchPlane(CoalesceBackend):
             lane = self._next_lane
             self._next_lane += 1
             return lane
+
+    # -- speculation (doc/eval-cache.md "Speculative pad rows") -----------
+
+    def speculation_budget(self) -> int:
+        """Current speculative rows-per-dispatch cap (actuator getter)."""
+        with self._spec_lock:
+            return self._spec_budget
+
+    def set_speculation_budget(self, budget: int) -> None:
+        """Control-plane actuation: re-bound speculative pad-row fill.
+        0 pins speculation off (the controller's move when dispatch
+        fill is already high — padding is scarce, so speculation would
+        only displace nothing and pollute the cache's hot set)."""
+        with self._spec_lock:
+            self._spec_budget = max(0, int(budget))
+
+    def offer_speculation(
+        self, rows: np.ndarray, keys: Sequence[int]
+    ) -> int:
+        """Queue candidate positions for future pad rows. ``rows[i]`` is
+        the uint8 wire planes of UNSALTED az-position-key ``keys[i]``
+        (likely children of in-flight nodes, ranked by the caller).
+        Already-cached and already-queued keys are dropped; the queue is
+        FIFO-bounded at 4x the budget so stale candidates from finished
+        subtrees age out instead of occupying tomorrow's padding.
+        Returns the number of candidates accepted."""
+        if speculation_disabled():
+            return 0
+        with self._spec_lock:
+            budget = self._spec_budget
+            cap = 4 * budget
+        if budget <= 0:
+            return 0
+        cache = _eval_cache.get_az_cache()
+        accepted = 0
+        for i, key in enumerate(keys):
+            salted = (int(key) ^ self._salt) & _U64
+            if cache is not None and cache.contains(salted):
+                continue
+            with self._spec_lock:
+                if salted in self._spec_queue:
+                    continue
+                self._spec_queue[salted] = np.array(rows[i], copy=True)
+                accepted += 1
+                while len(self._spec_queue) > cap:
+                    self._spec_queue.popitem(last=False)
+        return accepted
+
+    def _take_speculation(self, room: int) -> List[Tuple[int, np.ndarray]]:
+        """Pop up to ``min(room, budget)`` queued candidates (FIFO)."""
+        if room <= 0 or speculation_disabled():
+            return []
+        out: List[Tuple[int, np.ndarray]] = []
+        with self._spec_lock:
+            take = min(room, self._spec_budget)
+            while take > 0 and self._spec_queue:
+                out.append(self._spec_queue.popitem(last=False))
+                take -= 1
+        return out
+
+    def _land_speculation(self, spec_keys, lg16, vals) -> None:
+        """Materialize-time sink for speculative rows: the exact fp16
+        wire payload lands in the process cache and the fleet tier —
+        the same stores a demand row feeds — so the NEXT probe of these
+        positions is a pre-wire hit instead of a dispatch row."""
+        cache = _eval_cache.get_az_cache()
+        for j, key in enumerate(spec_keys):
+            lg_row = np.asarray(lg16[j], np.float16)
+            val = np.float32(vals[j])
+            if cache is not None:
+                cache.insert(key, (lg_row, val))
+            if self._postier is not None:
+                self._postier.insert_az(key, lg_row, float(val))
 
     def warmup(self) -> None:
         """Compile shard 0's bucket shapes (first-traffic re-homing may
@@ -379,7 +505,11 @@ class AzDispatchPlane(CoalesceBackend):
         seg = self._staged.pop(group)
         shard = self._router.shard_of(group) if self._router else 0
         holder = self._run_rungs(shard, group, [seg])
-        return holder, {"n": n, "wire_bytes": int(seg.nbytes)}
+        return holder, {
+            "n": n,
+            "wire_bytes": int(seg.nbytes),
+            "slots": _holder_slots(holder),
+        }
 
     def _dispatch_segmented(self, tickets) -> None:
         segs = [self._staged.pop(tk.group) for tk in tickets]
@@ -387,12 +517,20 @@ class AzDispatchPlane(CoalesceBackend):
             self._router.shard_of(tickets[0].group) if self._router else 0
         )
         holder = self._run_rungs(shard, tickets[0].group, segs)
+        # One fused dispatch, one slots figure: parked on the FIRST
+        # ticket only, so the coalescer's per-dispatch fill sum
+        # (service._DispatchCoalescer._execute) counts it once.
+        slots = _holder_slots(holder)
         off = 0
-        for tk, seg in zip(tickets, segs):
+        for i, (tk, seg) in enumerate(zip(tickets, segs)):
             tk.values = holder
             tk.start = off
             tk.seg_size = len(seg)
-            tk.acct = {"n": tk.n, "wire_bytes": int(seg.nbytes)}
+            tk.acct = {
+                "n": tk.n,
+                "wire_bytes": int(seg.nbytes),
+                "slots": slots if i == 0 else 0,
+            }
             off += len(seg)
 
     # -- dispatch internals ------------------------------------------------
@@ -457,7 +595,7 @@ class AzDispatchPlane(CoalesceBackend):
             rows = segs[0] if len(segs) == 1 else np.concatenate(segs)
             limit = self._buckets[0] if rung == 2 else self._cap
             chunks = self._dispatch_chunks(shard, rows, limit)
-        return _AzValues(tuple(chunks))
+        return _AzValues(tuple(chunks), sink=self._land_speculation)
 
     def _dispatch_chunks(
         self, shard: int, rows: np.ndarray, cap_limit: int
@@ -469,11 +607,23 @@ class AzDispatchPlane(CoalesceBackend):
             bucket = self._bucket_for(k)
             buf = self._staging(shard, bucket)
             buf[:k] = rows[off : off + k]
+            # Pad rows the pow2 bucket already pays for become
+            # speculative eval slots (doc/eval-cache.md "Speculative
+            # pad rows"): park queued candidates at [k : k+s]. Demand
+            # consumers slice [:k], so results are byte-for-byte
+            # whatever rides the padding; _AzValues harvests [k : k+s]
+            # into the cache at materialize time.
+            spec = self._take_speculation(bucket - k)
+            for j, (_skey, srow) in enumerate(spec):
+                buf[k + j] = srow
+            spec_keys = tuple(skey for skey, _srow in spec)
             logits, values = self._fwd(self._replicas[shard], buf)
-            out.append((logits, values, k))
+            out.append((logits, values, k, spec_keys))
             with self._stats_lock:
                 self._rows_dispatched += k
                 self._slots_dispatched += bucket
+                self._spec_rows += len(spec)
+                self._pad_rows += bucket - k - len(spec)
             off += k
         return out
 
@@ -506,7 +656,10 @@ class AzDispatchPlane(CoalesceBackend):
                 "skipped_dispatches": self._skipped_dispatches,
                 "rows_dispatched": self._rows_dispatched,
                 "slots_dispatched": self._slots_dispatched,
+                "pad_rows": self._pad_rows,
+                "spec_rows": self._spec_rows,
             }
+        stats["speculation_budget"] = self.speculation_budget()
         stats["dispatch_fill"] = (
             stats["rows_dispatched"] / stats["slots_dispatched"]
             if stats["slots_dispatched"] else 0.0
@@ -520,11 +673,16 @@ class AzDispatchPlane(CoalesceBackend):
         return stats
 
     def _families(self):
-        from fishnet_tpu.telemetry.registry import counter_family
+        from fishnet_tpu.telemetry.registry import (
+            counter_family,
+            gauge_family,
+        )
 
         with self._stats_lock:
             hits = self._prewire_hits
             skipped = self._skipped_dispatches
+            pad = self._pad_rows
+            spec = self._spec_rows
         return [
             counter_family(
                 "fishnet_eval_cache_hits_total",
@@ -536,6 +694,25 @@ class AzDispatchPlane(CoalesceBackend):
                 "fishnet_az_skipped_dispatches_total",
                 "AZ microbatches fully satisfied pre-wire (no dispatch).",
                 skipped,
+            ),
+            counter_family(
+                "fishnet_dispatch_pad_rows_total",
+                "Padding slots shipped in device dispatches (bucket "
+                "size minus real entries), by dispatch path.",
+                pad,
+                labels={"path": "az"},
+            ),
+            counter_family(
+                "fishnet_az_speculative_rows_total",
+                "Pad rows repurposed as speculative evals (results "
+                "land in the cache/fleet tier).",
+                spec,
+            ),
+            gauge_family(
+                "fishnet_az_speculation_budget",
+                "Current speculative rows-per-dispatch cap (control-"
+                "plane actuator).",
+                self.speculation_budget(),
             ),
         ]
 
@@ -552,6 +729,16 @@ class AzDispatchPlane(CoalesceBackend):
         from fishnet_tpu.telemetry.registry import REGISTRY
 
         REGISTRY.unregister_collector(self._collector_token)
+
+
+def _holder_slots(holder: _AzValues) -> int:
+    """Total device slots (bucket widths) a dispatch's chunks shipped.
+    Read from the un-materialized chunk tuples; 0 after materialize
+    (then the figure has already been consumed by acct)."""
+    arr = holder._arr
+    if not arr:
+        return 0
+    return int(sum(int(chunk[0].shape[0]) for chunk in arr))
 
 
 def _env_int(name: str) -> Optional[int]:

@@ -54,6 +54,19 @@ def cache_disabled() -> bool:
     return os.environ.get("FISHNET_NO_EVAL_CACHE", "") == "1"
 
 
+def bounds_disabled() -> bool:
+    """The bounds-tier escape hatch (``FISHNET_NO_BOUNDS=1``), read per
+    call like :func:`cache_disabled`. With it set, no bound record is
+    ever probed, harvested or seeded — the search plane behaves
+    byte-for-byte like the exact-eval memo alone (doc/eval-cache.md
+    "Bounds tier"). The shared ``FISHNET_NO_EVAL_CACHE=1`` hatch
+    implies this one: bounds ride the same reuse plane."""
+    return (
+        cache_disabled()
+        or os.environ.get("FISHNET_NO_BOUNDS", "") == "1"
+    )
+
+
 #: Warm-restart snapshot file (doc/resilience.md "Graceful drain"): when
 #: set, the client persists the cache here on drain and reloads it at
 #: startup, so a restarted process's first batches resolve pre-wire
@@ -197,6 +210,15 @@ class EvalCache:
             else:
                 self._hits += 1
         return None if ent is None else ent[0]
+
+    def contains(self, h: int) -> bool:
+        """Stats-neutral membership test: no hit/miss accounting, no
+        generation refresh. For advisory callers (speculation admission)
+        whose probes must not skew the hit-rate telemetry the control
+        plane steers on."""
+        s = self._stripe_of(h)
+        with self._locks[s]:
+            return h in self._stripes[s]
 
     def insert(self, h: int, value: int) -> None:
         s = self._stripe_of(h)
@@ -467,6 +489,138 @@ class AzEvalCache(EvalCache):
         return n
 
 
+#: Bound-type codes, matching the native TT's ``TTBound`` enum
+#: (cpp/src/search.h) so records cross the ctypes boundary without
+#: translation: 0 = none/miss, 1 = upper bound (fail-low), 2 = lower
+#: bound (fail-high), 3 = exact.
+BOUND_NONE = 0
+BOUND_UPPER = 1
+BOUND_LOWER = 2
+BOUND_EXACT = 3
+
+#: The native 21-bit packed-move "no move" sentinel (all ones). Bound
+#: records store moves in packed native form — they are only ever fed
+#: back through ``fc_pool_tt_fill_bound``, never decoded host-side.
+MOVE_NONE_BITS = 0x1FFFFF
+
+#: Default bound on bounds-tier entries. Each record is a small tuple
+#: (5 ints + generation); 64k entries cover the working set of a long
+#: analysis session at a few MB.
+DEFAULT_BOUNDS_CAPACITY = 1 << 16
+
+
+class BoundsCache(EvalCache):
+    """Bound-record twin of :class:`EvalCache`: each entry is
+    ``(value, eval, depth, bound, move_bits, uci)`` — a full search fact in
+    the native TT's own representation (value in stored/value_to_tt
+    form, move packed 21-bit), keyed ``zobrist ^ net_fingerprint`` like
+    the exact-eval memo. Unlike the memo, replacement is
+    **deeper-entry-wins**: a same-key insert only lands when its depth
+    is >= the resident entry's (an exact bound additionally beats a
+    non-exact one at equal depth), so a shallow re-search can never
+    clobber the deep record that makes the cutoff. Striping,
+    generation eviction and stats are inherited."""
+
+    def insert_bound(
+        self,
+        h: int,
+        value: int,
+        eval_: int,
+        depth: int,
+        bound: int,
+        move_bits: int,
+        uci: Optional[str] = None,
+    ) -> bool:
+        """Deeper-entry-wins insert; returns True when the record
+        landed (new key, or it beat the resident entry). ``uci`` is the
+        best move in UCI form when the harvester knows it (PV replay) —
+        the submit-time chain walk needs a move it can PLAY on a host
+        board, while ``move_bits`` (the packed native form) is what
+        seeds the pool TT."""
+        if bound <= BOUND_NONE or bound > BOUND_EXACT:
+            return False
+        s = self._stripe_of(h)
+        gen = self._generation
+        rec = (
+            int(value), int(eval_), int(depth), int(bound),
+            int(move_bits), uci,
+        )
+        with self._locks[s]:
+            stripe = self._stripes[s]
+            ent = stripe.get(h)
+            if ent is not None:
+                old = ent[0]
+                if old[2] > depth or (
+                    old[2] == depth
+                    and old[3] == BOUND_EXACT
+                    and bound != BOUND_EXACT
+                ):
+                    # Refresh the survivor's generation — it just proved
+                    # it is hot.
+                    stripe[h] = (old, gen)
+                    return False
+            elif len(stripe) >= self._stripe_cap:
+                self._evict_locked(s)
+            stripe[h] = (rec, gen)
+        with self._meta_lock:
+            self._insertions += 1
+        return True
+
+    def probe_bound(
+        self, h: int
+    ) -> Optional[Tuple[int, int, int, int, int, Optional[str]]]:
+        """Cached bound record for ``h``, or None. Hits refresh the
+        entry's generation like the base probe."""
+        s = self._stripe_of(h)
+        gen = self._generation
+        with self._locks[s]:
+            ent = self._stripes[s].get(h)
+            if ent is not None:
+                self._stripes[s][h] = (ent[0], gen)
+        with self._meta_lock:
+            if ent is None:
+                self._misses += 1
+            else:
+                self._hits += 1
+        return None if ent is None else ent[0]
+
+    def probe_bounds_block(
+        self, hashes: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Vector probe: returns ``(values, evals, depths, bounds,
+        moves)`` int32/uint32 arrays with ``bounds[i] == BOUND_NONE``
+        marking a miss — the exact column layout
+        ``fc_pool_tt_fill_bound`` consumes, so the seeding loop never
+        unpacks tuples per row on the hot path."""
+        n = len(hashes)
+        values = np.zeros(n, dtype=np.int32)
+        evals = np.zeros(n, dtype=np.int32)
+        depths = np.zeros(n, dtype=np.int32)
+        bounds = np.zeros(n, dtype=np.int32)
+        moves = np.full(n, MOVE_NONE_BITS, dtype=np.uint32)
+        hits = 0
+        gen = self._generation
+        for i in range(n):
+            h = int(hashes[i])
+            s = self._stripe_of(h)
+            with self._locks[s]:
+                ent = self._stripes[s].get(h)
+                if ent is not None:
+                    self._stripes[s][h] = (ent[0], gen)
+            if ent is not None:
+                v, e, d, b, m = ent[0][:5]
+                values[i] = v
+                evals[i] = e
+                depths[i] = d
+                bounds[i] = b
+                moves[i] = m
+                hits += 1
+        with self._meta_lock:
+            self._hits += hits
+            self._misses += n - hits
+        return values, evals, depths, bounds, moves
+
+
 # -- process-wide singleton -----------------------------------------------
 
 _global_lock = threading.Lock()
@@ -474,6 +628,8 @@ _global_cache: Optional[EvalCache] = None
 _collector_token: Optional[int] = None
 _global_az_cache: Optional[AzEvalCache] = None
 _az_collector_token: Optional[int] = None
+_global_bounds_cache: Optional[BoundsCache] = None
+_bounds_collector_token: Optional[int] = None
 
 
 def _collect_families():
@@ -553,6 +709,58 @@ def get_az_cache() -> Optional[AzEvalCache]:
         return _global_az_cache
 
 
+def _collect_bounds_families():
+    """Registry collector for the bounds tier: same family names,
+    tagged ``family="bounds"`` (consumption counters — seeds, cutoff
+    credit — are exported by the service collector)."""
+    cache = _global_bounds_cache
+    if cache is None:
+        return None  # self-unregister after reset_cache()
+    from ..telemetry.registry import counter_family, gauge_family
+
+    st = cache.stats()
+    return [
+        gauge_family(
+            "fishnet_eval_cache_entries",
+            "Live entries in the process-wide eval cache.",
+            st["entries"],
+            labels={"family": "bounds"},
+        ),
+        counter_family(
+            "fishnet_eval_cache_evictions_total",
+            "Entries evicted from the eval cache (generation sweeps).",
+            st["evictions"],
+            labels={"family": "bounds"},
+        ),
+    ]
+
+
+def get_bounds_cache() -> Optional[BoundsCache]:
+    """The process-wide bounds cache, or None when ``FISHNET_NO_BOUNDS=1``
+    (or the shared cache hatch) is set. Created on first use; capacity
+    via ``FISHNET_BOUNDS_CACHE_CAPACITY``. A separate instance from
+    :func:`get_cache`: bound records and exact evals have different
+    replacement policies (deeper-entry-wins vs last-write), so sharing
+    a table would let a shallow eval overwrite a deep cutoff record."""
+    if bounds_disabled():
+        return None
+    global _global_bounds_cache, _bounds_collector_token
+    with _global_lock:
+        if _global_bounds_cache is None:
+            cap = int(
+                os.environ.get(
+                    "FISHNET_BOUNDS_CACHE_CAPACITY", DEFAULT_BOUNDS_CAPACITY
+                )
+            )
+            _global_bounds_cache = BoundsCache(capacity=cap)
+            from ..telemetry.registry import REGISTRY
+
+            _bounds_collector_token = REGISTRY.register_collector(
+                _collect_bounds_families, name="bounds-cache"
+            )
+        return _global_bounds_cache
+
+
 def get_cache() -> Optional[EvalCache]:
     """The process-wide cache, or None when FISHNET_NO_EVAL_CACHE=1.
     Created on first use; capacity via FISHNET_EVAL_CACHE_CAPACITY."""
@@ -577,10 +785,11 @@ def reset_cache() -> None:
     """Tear down the process caches — BOTH families; a cold start is a
     cold start (tests / bench cold runs). The registered collectors
     self-unregister on their next scrape."""
-    global _global_cache, _global_az_cache
+    global _global_cache, _global_az_cache, _global_bounds_cache
     with _global_lock:
         _global_cache = None
         _global_az_cache = None
+        _global_bounds_cache = None
 
 
 # -- warm-restart snapshot --------------------------------------------------

@@ -168,10 +168,16 @@ class _Search:
         self.started = time.monotonic()
         self.visits_done = 0
         self.stop = False
-        # Pending leaf evals:
-        # (path of (node_id, edge), planes, moves, stm_white, kind, key)
+        # Pending leaf evals: (path of (node_id, edge), planes, moves,
+        # stm_white, kind, key, fen). The fen trails the tuple so the
+        # pool can build speculative CHILD candidates for the dispatch
+        # plane's pad rows (az_plane.offer_speculation) without a
+        # second movegen/encode pass.
         self.pending: List[
-            Tuple[List[Tuple[int, int]], np.ndarray, List[str], bool, str, int]
+            Tuple[
+                List[Tuple[int, int]], np.ndarray, List[str], bool, str,
+                int, str,
+            ]
         ] = []
         # The root itself needs an eval before any simulation can run.
         self._root_ready = False
@@ -284,9 +290,10 @@ class _Search:
             key = _position_key(b)
             ent = self.memo.get(key) if self.memo is not None else None
             if ent is None:
+                fen = b.fen()
                 self.pending.append(
-                    ([], board_planes(b.fen()), moves, b.turn() == "w",
-                     "root", key)
+                    ([], board_planes(fen), moves, b.turn() == "w",
+                     "root", key, fen)
                 )
                 return
             # Memoized root: expand in place and keep collecting leaves
@@ -348,16 +355,17 @@ class _Search:
                 self.visits_done += 1
                 continue
             self.nodes[parent_id].child[edge] = PENDING_CHILD
-            self.pending.append((path, board_planes(board.fen()), moves,
-                                 board.turn() == "w", "leaf", key))
+            fen = board.fen()
+            self.pending.append((path, board_planes(fen), moves,
+                                 board.turn() == "w", "leaf", key, fen))
         self._adapt()
 
     def apply_evals(self, results: List[Tuple[np.ndarray, float]]) -> None:
         """results[i] = (policy_logits [4672], value) for self.pending[i]."""
         memo = self.memo
-        for (path, _planes, moves, stm_white, kind, key), (logits, value) in zip(
-            self.pending, results
-        ):
+        for (path, _planes, moves, stm_white, kind, key, _fen), (
+            logits, value,
+        ) in zip(self.pending, results):
             idx = legal_policy_indices(moves, stm_white)
             logit = logits[idx]
             if logit.size:
@@ -707,6 +715,7 @@ class MctsPool:
         self._collisions = 0
         self._evals = 0
         self._steps = 0
+        self._spec_offered = 0
         global _collector_on
         with _TEL_LOCK:
             _POOLS.add(self)
@@ -883,13 +892,25 @@ class MctsPool:
         logits, values = self._evaluator.evaluate(batch, n_used, keys)
 
         cursor = 0
+        spec_plane = self._spec_plane()
+        spec_src: List[Tuple[str, List[str], bool, np.ndarray]] = []
         for s, k in contributors:
             results = [
                 (logits[cursor + j], float(values[cursor + j])) for j in range(k)
             ]
             cursor += k
+            if spec_plane is not None:
+                # Capture (fen, moves, stm, logits) before apply_evals
+                # clears pending: the evaluated leaves' TOP-PRIOR
+                # children are the positions selection reaches next.
+                for j, item in enumerate(s.pending):
+                    spec_src.append(
+                        (item[6], item[2], item[3], results[j][0])
+                    )
             s.apply_evals(results)
             self._drain_counters(s)
+        if spec_plane is not None and spec_src:
+            self._offer_speculation(spec_plane, spec_src)
         self._evals += n_used
         self._steps += 1
         fill = n_used / cap
@@ -898,6 +919,64 @@ class MctsPool:
             else 0.9 * self._fill_ema + 0.1 * fill
         )
         return n_used
+
+    # -- speculative pad-row candidates (az_plane) -------------------------
+
+    def _spec_plane(self):
+        """The shared dispatch plane, when it accepts speculation right
+        now (hatch off, budget > 0) — else None. Read per step so the
+        control plane's budget actuation and the env hatch both take
+        effect between steps without re-wiring the evaluator."""
+        plane = getattr(self._evaluator, "plane", None)
+        if plane is None or not hasattr(plane, "offer_speculation"):
+            return None
+        from fishnet_tpu.search.az_plane import speculation_disabled
+
+        if speculation_disabled() or plane.speculation_budget() <= 0:
+            return None
+        return plane
+
+    def _offer_speculation(self, plane, src) -> None:
+        """Build child candidates from this step's evaluated leaves and
+        queue them for the plane's pad rows. Ranked by policy prior —
+        the AZ analog of miss-history ranking: the highest-prior child
+        of a just-expanded node is the position PUCT selects next, so
+        it is the likeliest future cache probe. Bounded at 2x the
+        budget per step; encode cost stays a handful of boards."""
+        budget = plane.speculation_budget()
+        ranked: List[Tuple[float, str, str]] = []
+        for fen, moves, stm_white, logits in src:
+            idx = legal_policy_indices(moves, stm_white)
+            if not len(idx):
+                continue
+            lg = logits[idx]
+            lg = lg - lg.max()
+            p = np.exp(lg)
+            p /= p.sum()
+            j = int(p.argmax())
+            ranked.append((float(p[j]), fen, moves[j]))
+        ranked.sort(key=lambda t: -t[0])
+        rows: List[np.ndarray] = []
+        keys: List[int] = []
+        for _prob, fen, move in ranked[: max(1, 2 * budget)]:
+            board = Board(fen)
+            try:
+                board.push_uci(move)
+            except ValueError:
+                continue
+            if board.outcome() != Board.ONGOING:
+                continue
+            planes = board_planes(board.fen())
+            u8 = planes.astype(np.uint8)
+            u8[..., 17] = np.clip(
+                np.rint(planes[..., 17] * 100.0), 0, 255
+            )
+            rows.append(u8)
+            keys.append(_position_key(board))
+        if rows:
+            self._spec_offered += plane.offer_speculation(
+                np.stack(rows), keys
+            )
 
     def finished(self) -> List[int]:
         with self._lock:
@@ -936,6 +1015,7 @@ class MctsPool:
             "reuse_hits": self._reuse_hits,
             "memo_hits": self._memo_hits,
             "memo_entries": len(self._memo) if self._memo is not None else 0,
+            "spec_offered": self._spec_offered,
         }
         ev = self._evaluator
         if ev is not None and hasattr(ev, "counters"):

@@ -72,6 +72,12 @@ class _Pending:
     token: object = None
     stop_event: Optional[threading.Event] = None
     thread: int = 0  # owning driver thread index
+    # Root position for the bounds-tier PV harvest (_finish_slot
+    # replays the PV from here to export the pool TT's bound records).
+    # Empty when the harvest does not apply (bounds off, non-standard
+    # variant).
+    fen: str = ""
+    moves: str = ""
 
 
 def _bind_pool_api(lib: ctypes.CDLL) -> None:
@@ -145,6 +151,21 @@ def _bind_pool_api(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32,
     ]
     lib.fc_pool_tt_fill.restype = None
+    # ABI 11: bounds-tier surface (doc/eval-cache.md "Bounds tier") —
+    # seed full bound records into the pool TT, harvest bound-carrying
+    # entries back out for the process/fleet bounds tier.
+    lib.fc_pool_tt_fill_bound.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32,
+    ]
+    lib.fc_pool_tt_fill_bound.restype = None
+    lib.fc_pool_tt_export.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.fc_pool_tt_export.restype = ctypes.c_int
     lib._pool_bound = True
 
 
@@ -362,6 +383,14 @@ _COUNTER_METRICS = {
         "fishnet_eval_cache_skipped_dispatches_total", "counter",
         "Device dispatches skipped entirely because every entry of the "
         "batch was satisfied by the process-wide eval cache."),
+    "bounds_seeded": (
+        "fishnet_bounds_seeded_total", "counter",
+        "Bound records seeded into the pool TT pre-dispatch (batch "
+        "probe + submit-time best-move chain walk)."),
+    "bounds_harvested": (
+        "fishnet_bounds_harvested_total", "counter",
+        "Bound records exported from the pool TT into the bounds tier "
+        "at search finish (PV replay)."),
     "inflight_dispatches": ("fishnet_inflight_dispatches", "gauge",
                             "Device dispatches currently in flight in the "
                             "async pipeline (0..2: the ping-pong double "
@@ -519,6 +548,24 @@ _COALESCE_ERRORS = _telemetry.REGISTRY.counter(
     "every owning driver thread at resolve time (R5: counted, not "
     "swallowed).",
 )
+#: Pad-row waste observability (doc/observability.md): slots shipped to
+#: the device beyond the dispatch's real entries — the pow2 bucket
+#: ladder's padding, previously visible only in bench output. Labeled
+#: by path; the AZ plane and the rpc host export the same family under
+#: their own labels (the registry merges same-name families).
+_PAD_ROWS = _telemetry.REGISTRY.counter(
+    "fishnet_dispatch_pad_rows_total",
+    "Padding slots shipped in device dispatches (bucket size minus "
+    "real entries), by dispatch path.",
+    labelnames=("path",),
+)
+_HARVEST_ERRORS = _telemetry.REGISTRY.counter(
+    "fishnet_bounds_harvest_errors_total",
+    "Bounds-tier harvests that raised after a completed search. "
+    "Harvest is advisory — the search result ships regardless — but a "
+    "silent failure here starves warm re-searches of their seed "
+    "records (R5: counted, not swallowed).",
+)
 
 #: Per-shard degradation-ladder rungs (doc/sharding.md), mirrors
 #: resilience/supervisor.py RUNGS — the mesh path steps ONE shard down
@@ -594,7 +641,7 @@ class _CoalesceTicket:
     __slots__ = (
         "group", "n", "rows", "values", "start", "seg_size", "acct",
         "error", "done", "trace", "hashes", "cache_mask", "cache_vals",
-        "owners", "cost_t0",
+        "owners", "cost_t0", "fill",
     )
 
     def __init__(
@@ -627,6 +674,10 @@ class _CoalesceTicket:
         self.hashes = hashes
         self.cache_mask = cache_mask
         self.cache_vals = cache_vals
+        # Real-entries / shipped-slots ratio of the dispatch this ticket
+        # rode, stamped by _execute — the dispatch_issue span's fill
+        # attr and the pad-row counter's source (doc/observability.md).
+        self.fill: Optional[float] = None
 
 
 class CoalesceBackend:
@@ -963,6 +1014,37 @@ class _DispatchCoalescer:
                 self.fused_dispatches += 1
                 self.coalesced_steps += len(tickets)
         _COALESCE_WIDTH.observe(len(tickets))
+        # Pad-row accounting: tuple accts carry each segment's shipped
+        # bucket in acct[0] (the NNUE wire), so bucket minus real
+        # entries is exactly the padding the pow2 ladder added. Other
+        # backends (dict accts: the AZ plane) account padding at their
+        # own chunk level. Stamp the dispatch's fill on every ticket for
+        # the dispatch_issue span (async path reads tickets[0].fill).
+        slots = sum(
+            tk.acct[0]
+            for tk in tickets
+            if isinstance(tk.acct, tuple) and tk.acct
+        )
+        dict_slots = sum(
+            tk.acct.get("slots", 0)
+            for tk in tickets
+            if isinstance(tk.acct, dict)
+        )
+        if slots > 0:
+            real = sum(tk.n for tk in tickets)
+            pad = max(0, slots - real)
+            if pad:
+                _PAD_ROWS.inc(pad, path="service")
+            fill = real / slots
+            for tk in tickets:
+                tk.fill = fill
+        elif dict_slots > 0:
+            # Dict-acct backends (the AZ plane) count pad rows at their
+            # own chunk level (speculation may repurpose some); only the
+            # per-dispatch fill attr is stamped here.
+            fill = sum(tk.n for tk in tickets) / dict_slots
+            for tk in tickets:
+                tk.fill = fill
         if cost_on:
             # Record attribution ONCE per physical dispatch: inline for
             # the sync path (the wall below includes compute because
@@ -1284,6 +1366,7 @@ class _AsyncDispatchPipeline:
                     "dispatch_issue", t0, trace=issue_ctx, links=links,
                     seq=seq, width=len(tickets),
                     n=sum(tk.n for tk in tickets),
+                    fill=tickets[0].fill,
                     shard=self._shard,
                 )
             self._decode_q.put((seq, lseq, tickets, issue_ctx, links))
@@ -1829,6 +1912,18 @@ class SearchService(CoalesceBackend):
             self._postier = _postier_mod.get_tier()
         else:
             self._postier = None
+        # BOUNDS TIER (doc/eval-cache.md "Bounds tier"): cached search
+        # facts (value/depth/bound/best-move) keyed like the exact-eval
+        # memo. Consumed pre-dispatch (batch seed into the pool TT +
+        # submit-time best-move chain walk) and refilled at harvest
+        # (PV-replay TT export in _finish_slot). None with
+        # FISHNET_NO_BOUNDS=1 — every new call site gates on it, so the
+        # hatch restores the exact-eval-only plane byte-for-byte.
+        self._bounds_cache = (
+            _eval_cache_mod.get_bounds_cache()
+            if self._eval_cache is not None
+            else None
+        )
         # Opt-in cache-miss prefetch steering (tentpole part 4): high
         # sustained hit rates pin the speculative budget down (the
         # cache already serves those leaves for free), miss-heavy
@@ -1871,6 +1966,11 @@ class SearchService(CoalesceBackend):
         self._cache_prewire_hits = 0
         self._cache_skipped_dispatches = 0
         self._position_dedup = 0
+        # Bounds-tier traffic (doc/eval-cache.md "Bounds tier"): TT
+        # records seeded pre-dispatch (batch probe + submit-time chain
+        # walk) and records harvested back out of the pool TT.
+        self._bounds_seeded = 0
+        self._bounds_harvested = 0
         # Host->device payload actually shipped, split feature-side
         # (packed rows + buckets + parents + row count) vs the material
         # term — the split is what shows the ABI 9 wire saving in BENCH.
@@ -2351,6 +2451,8 @@ class SearchService(CoalesceBackend):
             out["cache_prewire_hits"] = self._cache_prewire_hits
             out["cache_skipped_dispatches"] = self._cache_skipped_dispatches
             out["position_dedup"] = self._position_dedup
+            out["bounds_seeded"] = self._bounds_seeded
+            out["bounds_harvested"] = self._bounds_harvested
         ec = self._eval_cache
         if ec is not None:
             st = ec.stats()
@@ -3116,7 +3218,19 @@ class SearchService(CoalesceBackend):
                         NativeCoreError(f"submit failed ({slot})"),
                     )
                     continue
-                p = _Pending(future, loop, time.monotonic(), token, stop_event, t)
+                # Bounds tier (doc/eval-cache.md "Bounds tier"): walk
+                # the cached best-move chain from the root and seed the
+                # pool TT before the search takes its first step, and
+                # remember the root so _finish_slot can harvest the
+                # PV's bound records back out. Standard chess only —
+                # bound records never cross variant rule sets.
+                std = variant == Variant.STANDARD
+                if std and self._bounds_cache is not None:
+                    self._seed_bound_chain(fen, moves)
+                p = _Pending(
+                    future, loop, time.monotonic(), token, stop_event, t,
+                    fen=fen if std else "", moves=moves,
+                )
                 # Under _lock: the event-loop side (watchdog, cancel,
                 # poke) identity-checks this map before stopping a slot.
                 with self._lock:
@@ -3310,6 +3424,49 @@ class SearchService(CoalesceBackend):
                                     (hashes ^ salt)[newly], hvals[newly]
                                 )
                                 hits += fleet_hits
+                        # BOUNDS PRE-WIRE SEED (doc/eval-cache.md
+                        # "Bounds tier"): cached search facts for this
+                        # batch's positions land in the pool TT BEFORE
+                        # the dispatch — exact/deep entries give the
+                        # native search outright cutoffs and window
+                        # narrowing (search.cpp tt cutoff), best-moves
+                        # drive its move ordering (tt_move). Misses
+                        # fall through to the fleet bounds region, and
+                        # fleet hits are promoted into the process
+                        # bounds cache, mirroring the eval ladder.
+                        bcache = self._bounds_cache
+                        if bcache is not None and n:
+                            t0b = time.monotonic() if tel else 0.0
+                            salted = hashes ^ salt
+                            bv, be, bd, bb, bmv = (
+                                bcache.probe_bounds_block(salted)
+                            )
+                            if self._postier is not None and not bb.all():
+                                pre = bb != 0
+                                self._postier.probe_bounds_block(
+                                    salted, bv, be, bd, bb, bmv
+                                )
+                                for i in np.nonzero((bb != 0) & ~pre)[0]:
+                                    bcache.insert_bound(
+                                        int(salted[i]), int(bv[i]),
+                                        int(be[i]), int(bd[i]),
+                                        int(bb[i]), int(bmv[i]),
+                                    )
+                            brows = np.nonzero(bb)[0]
+                            for i in brows:
+                                lib.fc_pool_tt_fill_bound(
+                                    self._pool, int(hashes[i]),
+                                    int(bv[i]), int(be[i]), int(bd[i]),
+                                    int(bb[i]), int(bmv[i]),
+                                )
+                            if len(brows):
+                                with self._lock:
+                                    self._bounds_seeded += len(brows)
+                            if tel:
+                                _SPANS.record(
+                                    "bounds_probe", t0b, trace=dctx,
+                                    group=g, n=n, hits=int(len(brows)),
+                                )
                         self._miss_hist.record(g, hits, n)
                         if self._cache_steer:
                             self._steer_prefetch(g)
@@ -3437,6 +3594,16 @@ class SearchService(CoalesceBackend):
                 )
             )
         lib.fc_pool_release(self._pool, slot)
+        # Bounds-tier harvest: the pool TT is pool-global (slots share
+        # one table), so exporting after release reads the records this
+        # search just wrote. PV replay gives the exact keys to ask for.
+        if pending.fen and lines and self._bounds_cache is not None:
+            try:
+                self._harvest_bounds(pending.fen, pending.moves, lines)
+            except Exception:
+                # Harvest is advisory; never fail a search result — but
+                # count it so the telemetry plane sees the starvation.
+                _HARVEST_ERRORS.inc()
         result = SearchResultData(
             lines=lines,
             best_move=bm.value.decode() or None,
@@ -3445,6 +3612,187 @@ class SearchService(CoalesceBackend):
             time_seconds=max(1e-6, time.monotonic() - pending.started),
         )
         pending.loop.call_soon_threadsafe(_set_res, pending.future, result)
+
+    def _seed_bound_chain(self, fen: str, moves: str) -> None:
+        """Walk the cached best-move chain from the search root and seed
+        each hop's bound record into the pool TT before the search takes
+        its first step. The chain follows stored best-moves (the cached
+        PV), so a warm re-search starts with its principal variation's
+        windows and move ordering already in the table — that is where
+        cutoffs pay, not at random leaves.
+
+        The ROOT position's own record is walked but never seeded: the
+        root's move ordering, aspiration window and final best-move
+        choice stay owned by the live search, so a seeded root record
+        can't tip the tie-break among equal-scored root moves — the
+        root best-move/score parity the DEPTH gate pins (bench.py
+        --depth). Interior hops are where cutoffs repay anyway.
+
+        The chain alone is short in practice — the material rungs tie
+        scores so often that reported PVs collapse to a ply or two —
+        so the walk is paired with a ROOT FAN-OUT: every legal root
+        child is block-probed (``probe_bounds_block``) and its record
+        seeded. The previous search stored a depth-(d-1) record under
+        every root child it searched, and those are exactly the nodes
+        the re-search's null-window root probes hit first, so the early
+        iterations cut at every non-PV child instead of re-walking
+        their subtrees. Caller gates on ``self._bounds_cache``
+        (FISHNET_NO_BOUNDS hatch) and standard chess; replay errors
+        just end the walk."""
+        from fishnet_tpu.chess.board import (
+            Board,
+            IllegalMoveError,
+            InvalidFenError,
+        )
+
+        bcache = self._bounds_cache
+        try:
+            board = Board(fen)
+            for tok in moves.split():
+                board.push_uci(tok)
+        except (InvalidFenError, IllegalMoveError, ValueError):
+            return
+        salt = int(self._cache_salt)
+        seeded = 0
+        done = set()
+        # Root fan-out: block-probe every legal child of the root.
+        root_fen = board.fen()
+        child_keys = []
+        for mv in board.legal_moves():
+            try:
+                child = Board(root_fen)
+                child.push_uci(mv)
+            except (InvalidFenError, IllegalMoveError, ValueError):
+                continue
+            child_keys.append(child.zobrist_hash())
+        if child_keys:
+            karr = np.array(child_keys, dtype=np.uint64)
+            cv, ce, cd, cb, cm = bcache.probe_bounds_block(
+                karr ^ np.uint64(salt)
+            )
+            for i in np.nonzero(cb)[0]:
+                z = int(karr[i])
+                self._lib.fc_pool_tt_fill_bound(
+                    self._pool, z, int(cv[i]), int(ce[i]), int(cd[i]),
+                    int(cb[i]), int(cm[i]),
+                )
+                done.add(z)
+                seeded += 1
+        for hop in range(24):  # chain cap: PVs past this carry no signal
+            z = board.zobrist_hash()
+            rec = bcache.probe_bound((z ^ salt) & 0xFFFFFFFFFFFFFFFF)
+            if rec is None:
+                break
+            value, eval_, depth_, bound, move_bits, uci = rec
+            if hop > 0 and z not in done:  # root: follow, never seed
+                self._lib.fc_pool_tt_fill_bound(
+                    self._pool, z, int(value), int(eval_), int(depth_),
+                    int(bound), int(move_bits),
+                )
+                seeded += 1
+            if not uci:
+                break
+            try:
+                board.push_uci(uci)
+            except (IllegalMoveError, ValueError):
+                break
+        if seeded:
+            with self._lock:
+                self._bounds_seeded += seeded
+
+    def _harvest_bounds(
+        self, fen: str, moves: str, lines: List[PvLineData]
+    ) -> None:
+        """Replay the finished search's principal variation and export
+        each node's bound record from the pool TT into the bounds tier
+        (process cache + fleet segment when attached). The PV nodes are
+        the ones whose records a future search wants: exact scores along
+        the line, the move chain for ordering. Because the material
+        rungs tie so often that reported PVs collapse to a ply or two,
+        the replay is widened with a ROOT FAN-OUT: every legal root
+        child's record is exported too — the last root iteration stored
+        a depth-(d-1) record under each, and the submit-time fan-out in
+        :meth:`_seed_bound_chain` is their consumer. The pool TT is
+        shared by all slots and survives release, so this reads what
+        the search just wrote."""
+        from fishnet_tpu.chess.board import (
+            Board,
+            IllegalMoveError,
+            InvalidFenError,
+        )
+
+        pv = lines[0].pv
+        try:
+            board = Board(fen)
+            for tok in moves.split():
+                board.push_uci(tok)
+        except (InvalidFenError, IllegalMoveError, ValueError):
+            return
+        keys: List[int] = [board.zobrist_hash()]
+        ucis: List[Optional[str]] = []
+        root_fen = board.fen()
+        root_children = board.legal_moves()
+        for tok in pv[:31]:  # root + <=31 plies per harvest
+            try:
+                board.push_uci(tok)
+            except (IllegalMoveError, ValueError):
+                break
+            ucis.append(tok)
+            keys.append(board.zobrist_hash())
+        ucis.append(None)  # PV tip: no known continuation
+        # Root fan-out, PV keys first: insert_bound's deeper-entry-wins
+        # replacement would let a same-depth uci=None child record
+        # clobber the PV record that carries the chain move, so PV
+        # duplicates are skipped here.
+        seen = set(keys)
+        for mv in root_children:
+            try:
+                child = Board(root_fen)
+                child.push_uci(mv)
+            except (InvalidFenError, IllegalMoveError, ValueError):
+                continue
+            z = child.zobrist_hash()
+            if z in seen:
+                continue
+            seen.add(z)
+            keys.append(z)
+            ucis.append(None)  # fan-out: chain ends here
+        n = len(keys)
+        karr = np.array(keys, dtype=np.uint64)
+        values = np.empty(n, dtype=np.int32)
+        evals = np.empty(n, dtype=np.int32)
+        depths = np.empty(n, dtype=np.int32)
+        bounds = np.empty(n, dtype=np.int32)
+        mvbits = np.empty(n, dtype=np.uint32)
+        hits = self._lib.fc_pool_tt_export(
+            self._pool,
+            karr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            n,
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            evals.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            depths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            mvbits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+        if hits <= 0:
+            return
+        bcache = self._bounds_cache
+        salt = np.uint64(int(self._cache_salt))
+        salted = karr ^ salt
+        for i in range(n):
+            if bounds[i] == 0:
+                continue
+            bcache.insert_bound(
+                int(salted[i]), int(values[i]), int(evals[i]),
+                int(depths[i]), int(bounds[i]), int(mvbits[i]),
+                uci=ucis[i],
+            )
+        if self._postier is not None:
+            self._postier.insert_bounds_block(
+                salted, values, evals, depths, bounds, mvbits
+            )
+        with self._lock:
+            self._bounds_harvested += int(hits)
 
     def _fail_all(self, t: int, err: Exception) -> None:
         """Resolve every outstanding future owned by thread ``t``:

@@ -174,6 +174,28 @@ SERIES_SPECS: Tuple[Spec, ...] = (
          0.0, "gate"),
     Spec("CONTROL", "gates_passed", "gates.passed", "true", 0.0,
          "gate"),
+    # -- DEPTH (bound-aware search plane; bench.py --depth) --------------
+    # Headline = steady-state warm median achieved depth gain over the
+    # FISHNET_NO_BOUNDS hatch at the fixed node budget. The parity
+    # sweep, both escape hatches, nodes/eval and the exactly-once
+    # ledger are hard gates; the raw depth level only watched (it moves
+    # with the node budget knob).
+    Spec("DEPTH", "warm_median_depth_gain", "value", "up", 0.50,
+         "gate"),
+    Spec("DEPTH", "warm_nodes_per_eval", "warm.nodes_per_eval", "up",
+         0.10, "gate"),
+    Spec("DEPTH", "warm_steady_nodes_per_eval",
+         "warm_steady.nodes_per_eval", "up", 0.10, "gate"),
+    Spec("DEPTH", "warm_steady_median_depth",
+         "warm_steady.median_depth", "up", 0.15, "watch"),
+    Spec("DEPTH", "parity_all_rungs", "parity.all", "true", 0.0,
+         "gate"),
+    Spec("DEPTH", "speculation_identical", "speculation.identical",
+         "true", 0.0, "gate"),
+    Spec("DEPTH", "ledger_lost", "ledger.lost", "zero", 0.0, "gate"),
+    Spec("DEPTH", "ledger_duplicated", "ledger.duplicated", "zero",
+         0.0, "gate"),
+    Spec("DEPTH", "gates_passed", "gates.passed", "true", 0.0, "gate"),
     # -- MCTS (shared-plane AZ bench) ------------------------------------
     Spec("MCTS", "warm_visits_per_s", "value", "up", 0.20, "gate"),
     Spec("MCTS", "cold_visits_per_s", "cold.visits_per_s", "up", 0.25,
